@@ -1,0 +1,74 @@
+"""Dtype registry: canonical string names <-> numpy/jax dtypes.
+
+Reference parity: framework.proto VarType (:94) dtype enum + platform/float16.h.
+On TPU, bfloat16 is the native 16-bit float; float16 is kept for API parity.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+# canonical name -> jnp dtype
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "int": "int32",
+    "long": "int64",
+    "fp16": "float16",
+    "bf16": "bfloat16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+INT_DTYPES = ("int8", "uint8", "int16", "int32", "int64")
+
+
+def canonicalize(dtype):
+    """Return canonical string name for a dtype given as str/np/jnp dtype."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name not in _NAME_TO_DTYPE:
+            raise ValueError(f"Unknown dtype: {dtype!r}")
+        return name
+    # numpy dtype / jnp dtype / python type
+    name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    name = _ALIASES.get(name, name)
+    if name not in _NAME_TO_DTYPE:
+        raise ValueError(f"Unknown dtype: {dtype!r}")
+    return name
+
+
+def to_jnp(dtype):
+    return _NAME_TO_DTYPE[canonicalize(dtype)]
+
+
+def to_np(dtype):
+    name = canonicalize(dtype)
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def is_float(dtype):
+    return canonicalize(dtype) in FLOAT_DTYPES
+
+
+def is_int(dtype):
+    return canonicalize(dtype) in INT_DTYPES

@@ -1,0 +1,177 @@
+"""Executor core: lowers a Program block to ONE jit-compiled XLA computation.
+
+Reference contrast: paddle/fluid/framework/executor.cc:133 interprets the op
+list one kernel launch at a time with a stream sync per run (executor.cc:353).
+On TPU the idiomatic execution model is trace-once/compile-once: the whole
+block — forward, backward, optimizer ops — becomes a single pure function
+    step(state, feeds, rng) -> (fetches, new_state)
+jit-compiled by XLA with donated state buffers, so parameters never leave the
+device and XLA fuses/schedules everything (its ThreadedSSAGraphExecutor
+equivalent is the XLA scheduler itself).
+
+An eager interpret mode (`run_ops_eager`) remains for host-side programs
+(save/load/print/readers) — the analogue of the reference's op-by-op path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import registry
+from .registry import SeqTensor
+from . import dtypes
+
+
+class TraceUnsupported(Exception):
+    """Raised when a block contains host-only ops and must run eagerly."""
+
+
+class OpContext:
+    """Per-trace context passed to kernels: RNG threading, sub-block
+    execution (control flow), test-mode flag."""
+
+    def __init__(self, rng=None, is_test=False, eager=False, scope=None, feed=None,
+                 fetch_sink=None, place=None):
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.is_test = is_test
+        self.eager = eager
+        self.scope = scope  # only in eager mode (host ops need it)
+        self.feed = feed or {}
+        self.fetch_sink = fetch_sink if fetch_sink is not None else []
+        self.place = place
+
+    def next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def run_block(self, block, env):
+        """Execute a sub-block's ops against `env` (control-flow ops)."""
+        run_ops(block.ops, env, self)
+        return env
+
+
+def env_get(env, name, allow_missing=False):
+    if name in env:
+        return env[name]
+    if allow_missing:
+        return None
+    raise KeyError(f"Variable {name!r} not materialized (missing feed or init?)")
+
+
+def run_ops(ops, env, ctx):
+    for op in ops:
+        op_def = registry.lookup(op.type)
+        if op_def.no_trace and not ctx.eager:
+            raise TraceUnsupported(op.type)
+        # control-flow / host ops need the op desc + live env (sub-block wiring)
+        ctx.current_op = op
+        ctx.env = env
+        ins = {}
+        for slot, names in op.inputs.items():
+            ins[slot] = [None if n == "" else env_get(env, n) for n in names]
+        try:
+            outs = registry.run_kernel(op_def, ctx, ins, op.attrs) or {}
+        except TraceUnsupported:
+            raise
+        except Exception as e:
+            raise type(e)(f"while running op {op.type!r} ({op!r}): {e}") from e
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot, [])
+            for i, name in enumerate(names):
+                if not name:
+                    continue
+                if i < len(vals) and vals[i] is not None:
+                    env[name] = vals[i]
+    return env
+
+
+# ---------------------------------------------------------------------------
+# Compiled path
+# ---------------------------------------------------------------------------
+def collect_state_names(program, scope):
+    """Persistable vars the block reads or writes and that exist in scope."""
+    gb = program.global_block()
+    persistable = {
+        n for b in program.blocks for n, v in b.vars.items() if v.persistable
+    }
+    touched = set()
+    for b in program.blocks:
+        for op in b.ops:
+            touched.update(op.input_arg_names())
+            touched.update(op.output_arg_names())
+    state_in = sorted(n for n in persistable & touched if scope.has_var(n))
+    written = set()
+    for b in program.blocks:
+        for op in b.ops:
+            written.update(set(op.output_arg_names()) & persistable)
+    return state_in, sorted(written)
+
+
+def build_step_fn(program, fetch_names, state_out_names, is_test=False):
+    """Build the pure step function for a program's global block.
+
+    signature: step(mut_state, const_state, feeds, rng) -> (fetches, new_mut)
+    mut_state (vars the block writes) is donated by the jit wrapper so
+    parameter/optimizer-state buffers are updated in place on device.
+    """
+    ops = program.global_block().ops
+
+    def step(mut_state, const_state, feeds, rng):
+        env = {}
+        env.update(const_state)
+        env.update(mut_state)
+        env.update(feeds)
+        ctx = OpContext(rng=rng, is_test=is_test)
+        run_ops(ops, env, ctx)
+        fetches = [env_get(env, n) for n in fetch_names]
+        new_mut = {n: env[n] for n in state_out_names if n in env}
+        return fetches, new_mut
+
+    return step
+
+
+def compile_step_fn(step, donate_state=True):
+    return jax.jit(step, donate_argnums=(0,) if donate_state else ())
+
+
+# ---------------------------------------------------------------------------
+# Feed/fetch conversion helpers
+# ---------------------------------------------------------------------------
+def feed_to_tracevalue(value, var=None):
+    """numpy / LoDTensor / jax array -> trace input (array or SeqTensor)."""
+    from .lod_tensor import LoDTensor
+
+    if isinstance(value, LoDTensor):
+        data = np.asarray(value.numpy())
+        if value.lod():
+            lengths = np.asarray(
+                [b - a for a, b in zip(value.last_level_offsets(), value.last_level_offsets()[1:])],
+                dtype=np.int32,
+            )
+            return SeqTensor(jnp.asarray(data), jnp.asarray(lengths))
+        return jnp.asarray(data)
+    if isinstance(value, SeqTensor):
+        return value
+    arr = np.asarray(value)
+    return jnp.asarray(arr)
+
+
+def value_to_lod_tensor(value):
+    """trace output -> LoDTensor (host)."""
+    from .lod_tensor import LoDTensor
+
+    if isinstance(value, SeqTensor):
+        lengths = np.asarray(value.lengths).tolist()
+        offsets = [0]
+        for l in lengths:
+            offsets.append(offsets[-1] + int(l))
+        t = LoDTensor(np.asarray(value.data), [offsets])
+        return t
+    return LoDTensor(np.asarray(value))
+
+
+def spec_of(value):
+    """Hashable signature of a trace input (for the compile cache)."""
+    if isinstance(value, SeqTensor):
+        return ("seq", tuple(value.data.shape), str(value.data.dtype), tuple(value.lengths.shape))
+    return (tuple(np.shape(value)), str(np.asarray(value).dtype) if not hasattr(value, "dtype") else str(value.dtype))

@@ -1,0 +1,117 @@
+"""LoDTensor: dense data + level-of-detail sequence offsets.
+
+Reference parity: paddle/fluid/framework/lod_tensor.h:58,110 — `LoD` is a
+list of offset vectors describing nested variable-length sequences laid out
+flat along dim 0.
+
+TPU-native representation: the flat data lives as a jax.Array with a
+STATIC dim-0 size (batches are padded/bucketed by DataFeeder so XLA sees
+static shapes); the lod offsets ride along as host numpy. In traced programs
+sequence ops consume a derived `segment_ids`/`lengths` int array (see
+ops/sequence_ops.py) so compute stays on-device with static shapes — this is
+the XLA answer to the reference's dynamic LoD kernels.
+"""
+
+import numpy as np
+
+
+def _offsets_to_lengths(level):
+    return [level[i + 1] - level[i] for i in range(len(level) - 1)]
+
+
+def _lengths_to_offsets(lengths):
+    out = [0]
+    for l in lengths:
+        out.append(out[-1] + l)
+    return out
+
+
+class LoDTensor:
+    def __init__(self, data=None, lod=None):
+        self._data = data  # np.ndarray or jax.Array
+        self._lod = [list(map(int, lv)) for lv in (lod or [])]
+
+    # -- reference API ------------------------------------------------------
+    def set(self, array, place=None):
+        self._data = np.asarray(array)
+
+    def set_lod(self, lod):
+        self._lod = [list(map(int, lv)) for lv in lod]
+
+    def lod(self):
+        return [list(lv) for lv in self._lod]
+
+    def set_recursive_sequence_lengths(self, lengths):
+        self._lod = [_lengths_to_offsets(lv) for lv in lengths]
+
+    def recursive_sequence_lengths(self):
+        return [_offsets_to_lengths(lv) for lv in self._lod]
+
+    def has_valid_recursive_sequence_lengths(self):
+        if not self._lod:
+            return True
+        n = self._data.shape[0] if self._data is not None else 0
+        prev_len = None
+        for i, level in enumerate(self._lod):
+            if not level or level[0] != 0:
+                return False
+            if any(level[j] > level[j + 1] for j in range(len(level) - 1)):
+                return False
+            if prev_len is not None and level[-1] != prev_len:
+                return False
+            prev_len = len(level) - 1 if i + 1 < len(self._lod) else None
+        return self._lod[-1][-1] == n if self._lod else True
+
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def data(self):
+        return self._data
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    # -- sequence helpers ---------------------------------------------------
+    def last_level_offsets(self):
+        """Offsets of the finest level, or trivial [0, N] when lod is empty."""
+        if self._lod:
+            return list(self._lod[-1])
+        n = self._data.shape[0] if self._data is not None else 0
+        return [0, n]
+
+    def num_sequences(self):
+        return len(self.last_level_offsets()) - 1
+
+    def __repr__(self):
+        shp = None if self._data is None else tuple(self._data.shape)
+        return f"LoDTensor(shape={shp}, lod={self._lod})"
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """reference python/paddle/fluid/lod_tensor.py create_lod_tensor."""
+    if isinstance(data, list):
+        # list of lists -> flatten; infer lengths
+        flattened = [item for seq in data for item in seq]
+        lengths = [len(seq) for seq in data]
+        arr = np.asarray(flattened)
+        if arr.ndim == 1:
+            arr = arr.reshape(-1, 1)
+        t = LoDTensor(arr)
+        t.set_recursive_sequence_lengths([lengths])
+        return t
+    t = LoDTensor(np.asarray(data))
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    assert t.has_valid_recursive_sequence_lengths(), "invalid lod lengths for data shape"
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low, high):
+    total = sum(recursive_seq_lens[-1])
+    shape = [total] + list(base_shape)
+    data = np.random.randint(low, high + 1, size=shape).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

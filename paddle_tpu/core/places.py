@@ -1,0 +1,80 @@
+"""Device places.
+
+Reference parity: paddle/fluid/platform/place.h:25-49 (CPUPlace / CUDAPlace /
+CUDAPinnedPlace). The TPU build's first-class accelerator place is TPUPlace;
+CUDAPlace is accepted as an alias for the accelerator place so reference-style
+scripts run unmodified (they do `fluid.CUDAPlace(0)`).
+"""
+
+import jax
+
+
+class Place:
+    def __eq__(self, other):
+        return type(self) is type(other) and getattr(self, "device_id", 0) == getattr(
+            other, "device_id", 0
+        )
+
+    def __hash__(self):
+        return hash((type(self).__name__, getattr(self, "device_id", 0)))
+
+    def __repr__(self):
+        return type(self).__name__ + "()"
+
+
+class CPUPlace(Place):
+    """Host CPU."""
+
+    platform = "cpu"
+
+
+class TPUPlace(Place):
+    """A single TPU chip (by local device index)."""
+
+    platform = "tpu"
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+# API-parity alias: reference scripts say CUDAPlace(0); here it means
+# "the accelerator" (TPU when present, else CPU backend device 0).
+class CUDAPlace(TPUPlace):
+    def __repr__(self):
+        return f"CUDAPlace({self.device_id})"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    """Pinned host memory place (host staging buffers). On TPU, host->device
+
+    transfer staging is managed by PjRt; this exists for API parity."""
+
+
+def is_compiled_with_tpu():
+    try:
+        return any(d.platform != "cpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+# reference API parity (`core.is_compiled_with_cuda`, pybind.cc)
+def is_compiled_with_cuda():
+    return is_compiled_with_tpu()
+
+
+def accelerator_count():
+    """Number of local accelerator devices (get_cuda_device_count parity)."""
+    return len([d for d in jax.devices() if d.platform != "cpu"]) or 0
+
+
+def jax_device_for(place):
+    """Map a Place to a concrete jax.Device."""
+    devs = jax.devices()
+    if isinstance(place, CPUPlace) and not isinstance(place, TPUPlace):
+        cpus = jax.devices("cpu") if any(d.platform == "cpu" for d in devs) else devs
+        return cpus[0]
+    accel = [d for d in devs if d.platform != "cpu"] or devs
+    return accel[getattr(place, "device_id", 0) % len(accel)]

@@ -1,0 +1,310 @@
+"""Operator registry: op type -> JAX kernel (+ grad maker metadata).
+
+Reference parity: paddle/fluid/framework/op_registry.h:129-167
+(REGISTER_OPERATOR / REGISTER_OP_*_KERNEL) and grad_op_desc_maker.h:34.
+
+A "kernel" here is a JAX-traceable callable
+    fn(ctx, ins: {slot: [values]}, attrs: {str: any}) -> {slot: [values]}
+executed inside the Executor's whole-block trace, so XLA (not a per-op
+dispatcher) schedules and fuses it. Values are jax arrays or SeqTensor
+(flat ragged data + lengths — the LoD equivalent, see lod_tensor.py).
+
+Gradients: an op either registers an explicit `<type>_grad` kernel, or the
+generic vjp fallback derives the grad kernel from the forward kernel with
+jax.vjp at trace time (exact, and XLA CSEs the recomputed forward). Ops with
+randomness or side effects must register explicit grads.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes
+
+
+# ---------------------------------------------------------------------------
+# SeqTensor: the in-trace LoD representation (1 nesting level).
+# data: [N, ...] flat tokens (N static, >= sum(lengths); tail rows = padding)
+# lengths: int32 [B] per-sequence token counts.
+# ---------------------------------------------------------------------------
+@jax.tree_util.register_pytree_node_class
+class SeqTensor:
+    def __init__(self, data, lengths):
+        self.data = data
+        self.lengths = lengths
+
+    def tree_flatten(self):
+        return (self.data, self.lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def batch(self):
+        return self.lengths.shape[0]
+
+    @property
+    def ntokens(self):
+        return self.data.shape[0]
+
+    def offsets(self):
+        """[B+1] exclusive-scan of lengths (LoD offsets)."""
+        return jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(self.lengths.astype(jnp.int32))]
+        )
+
+    def segment_ids(self):
+        """[N] int32: sequence index per token; padding rows get B."""
+        cum = jnp.cumsum(self.lengths.astype(jnp.int32))
+        return jnp.searchsorted(cum, jnp.arange(self.ntokens, dtype=jnp.int32), side="right").astype(
+            jnp.int32
+        )
+
+    def token_mask(self):
+        """[N] bool: True for real (non-padding) tokens."""
+        return self.segment_ids() < self.batch
+
+    def __repr__(self):
+        return f"SeqTensor(data={getattr(self.data, 'shape', None)}, B={self.lengths.shape})"
+
+
+def seq_data(x):
+    return x.data if isinstance(x, SeqTensor) else x
+
+
+class OpDef:
+    def __init__(self, type, fn, lod_aware=False, no_trace=False):
+        self.type = type
+        self.fn = fn
+        self.lod_aware = lod_aware
+        self.no_trace = no_trace  # host-side op (feed/fetch/reader/save...)
+        self.grad_maker = None  # custom IR-level grad maker (backward.py)
+        self.stop_gradient_outputs = ()  # output slots never differentiated
+
+
+_registry = {}
+
+
+def register_op(type, lod_aware=False, no_trace=False):
+    """Decorator: register the forward (or explicit grad) kernel for `type`."""
+
+    def deco(fn):
+        _registry[type] = OpDef(type, fn, lod_aware=lod_aware, no_trace=no_trace)
+        return fn
+
+    return deco
+
+
+def register_grad_maker(type):
+    """Decorator: custom IR-level grad maker for op `type`.
+
+    fn(op, grad_out_names: {out_slot: [grad names or None]},
+       grad_in_names: {in_slot: [grad names or None]}) -> [op_desc dicts]
+    See backward.py for the default (vjp) maker.
+    """
+
+    def deco(fn):
+        _get_or_stub(type).grad_maker = fn
+        return fn
+
+    return deco
+
+
+def set_stop_gradient_outputs(type, slots):
+    _get_or_stub(type).stop_gradient_outputs = tuple(slots)
+
+
+def _get_or_stub(type):
+    if type not in _registry:
+        _registry[type] = OpDef(type, None)
+    return _registry[type]
+
+
+def get_op_def(type):
+    op_def = _registry.get(type)
+    if op_def is not None and op_def.fn is not None:
+        return op_def
+    return None
+
+
+def has_op(type):
+    d = _registry.get(type)
+    return d is not None and d.fn is not None
+
+
+def lookup(type):
+    """Resolve a kernel for `type`; auto-derives `<T>_grad` via vjp."""
+    op_def = get_op_def(type)
+    if op_def is not None:
+        return op_def
+    if type.endswith("_grad"):
+        fwd = get_op_def(type[: -len("_grad")])
+        if fwd is not None:
+            auto = OpDef(type, make_vjp_kernel(fwd), lod_aware=True)
+            _registry[type] = auto if _registry.get(type) is None else _registry[type]
+            # preserve any pre-registered grad-maker stub entry
+            stub = _registry[type]
+            if stub.fn is None:
+                stub.fn = auto.fn
+                stub.lod_aware = True
+            return _registry[type]
+    raise NotImplementedError(f"No kernel registered for op type {type!r}")
+
+
+def registered_ops():
+    return sorted(k for k, v in _registry.items() if v.fn is not None)
+
+
+# ---------------------------------------------------------------------------
+# Generic vjp-derived gradient kernel.
+#
+# Convention for the auto grad op `<T>_grad` (emitted by backward.py's default
+# grad maker):
+#   inputs  = original input slots (original values)
+#           + f"{out_slot}@GRAD" slots with incoming output grads (may be
+#             absent -> treated as zeros)
+#   outputs = f"{in_slot}@GRAD" slots (parallel to inputs; empty name = skip)
+#   attrs   = original forward attrs
+# ---------------------------------------------------------------------------
+def _is_diff(v):
+    x = seq_data(v)
+    return hasattr(x, "dtype") and dtypes.is_float(np.dtype(x.dtype).name)
+
+
+def make_vjp_kernel(fwd_def):
+    fwd_fn = fwd_def.fn
+
+    def grad_kernel(ctx, ins, attrs):
+        grad_outs = {}
+        prim_ins = {}
+        for slot, vals in ins.items():
+            if slot.endswith("@GRAD"):
+                grad_outs[slot[: -len("@GRAD")]] = vals
+            else:
+                prim_ins[slot] = vals
+
+        if not fwd_def.lod_aware:
+            seq_meta = {
+                s: [v.lengths if isinstance(v, SeqTensor) else None for v in vals]
+                for s, vals in prim_ins.items()
+            }
+            prim_ins = {s: [seq_data(v) for v in vals] for s, vals in prim_ins.items()}
+            grad_outs = {s: [seq_data(v) for v in vals] for s, vals in grad_outs.items()}
+        else:
+            seq_meta = None
+
+        diff_idx = {
+            s: [i for i, v in enumerate(vals) if _is_diff(v)]
+            for s, vals in prim_ins.items()
+        }
+        diff_ins = {
+            s: [prim_ins[s][i] for i in idx] for s, idx in diff_idx.items() if idx
+        }
+
+        def fwd_closed(d_ins):
+            full = {s: list(vals) for s, vals in prim_ins.items()}
+            for s, idx in diff_idx.items():
+                for j, i in enumerate(idx):
+                    full[s][i] = d_ins[s][j]
+            return fwd_fn(ctx, full, attrs)
+
+        primal_outs, vjp_fn = jax.vjp(fwd_closed, diff_ins)
+
+        def float0_like(v):
+            return np.zeros(np.shape(v), jax.dtypes.float0)
+
+        def cot_for(o, g):
+            """Cotangent matching primal output o (float0 for int leaves)."""
+            if isinstance(o, SeqTensor):
+                gd = seq_data(g) if g is not None else None
+                data_cot = (
+                    gd.astype(o.data.dtype)
+                    if gd is not None and dtypes.is_float(np.dtype(o.data.dtype).name)
+                    else (
+                        jnp.zeros_like(o.data)
+                        if dtypes.is_float(np.dtype(o.data.dtype).name)
+                        else float0_like(o.data)
+                    )
+                )
+                return SeqTensor(data_cot, float0_like(o.lengths))
+            if not dtypes.is_float(np.dtype(o.dtype).name):
+                return float0_like(o)
+            if g is None:
+                return jnp.zeros_like(o)
+            return seq_data(g).astype(o.dtype)
+
+        cotangents = {}
+        for slot, outs in primal_outs.items():
+            gs = grad_outs.get(slot)
+            cotangents[slot] = [
+                cot_for(o, gs[i] if gs is not None and i < len(gs) else None)
+                for i, o in enumerate(outs)
+            ]
+        (d_ins,) = vjp_fn(cotangents)
+
+        result = {}
+        for slot, idx in diff_idx.items():
+            grads = [None] * len(prim_ins[slot])
+            for j, i in enumerate(idx):
+                g = d_ins[slot][j]
+                orig = prim_ins[slot][i]
+                if isinstance(g, SeqTensor):
+                    lengths = (
+                        orig.lengths
+                        if isinstance(orig, SeqTensor)
+                        else (seq_meta[slot][i] if seq_meta is not None else None)
+                    )
+                    g = SeqTensor(g.data, lengths)
+                elif seq_meta is not None and seq_meta[slot][i] is not None:
+                    g = SeqTensor(g, seq_meta[slot][i])
+                grads[i] = g
+            result[f"{slot}@GRAD"] = grads
+        return result
+
+    return grad_kernel
+
+
+# ---------------------------------------------------------------------------
+# Kernel-call wrapper used by the executor: handles SeqTensor auto-unwrap for
+# non-lod-aware kernels + LoD propagation (reference ShareLoD semantics).
+# ---------------------------------------------------------------------------
+def run_kernel(op_def, ctx, ins, attrs):
+    if op_def.lod_aware:
+        return op_def.fn(ctx, ins, attrs)
+
+    first_lengths = None
+    first_n = None
+    plain_ins = {}
+    for slot, vals in ins.items():
+        unwrapped = []
+        for v in vals:
+            if isinstance(v, SeqTensor):
+                if first_lengths is None:
+                    first_lengths, first_n = v.lengths, v.ntokens
+                unwrapped.append(v.data)
+            else:
+                unwrapped.append(v)
+        plain_ins[slot] = unwrapped
+
+    outs = op_def.fn(ctx, plain_ins, attrs)
+
+    if first_lengths is None:
+        return outs
+    wrapped = {}
+    for slot, vals in outs.items():
+        wrapped[slot] = [
+            SeqTensor(v, first_lengths)
+            if (
+                v is not None
+                and not isinstance(v, SeqTensor)
+                and hasattr(v, "shape")
+                and v.ndim >= 1
+                and v.shape[0] == first_n
+            )
+            else v
+            for v in vals
+        ]
+    return wrapped

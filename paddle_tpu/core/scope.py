@@ -1,0 +1,90 @@
+"""Scope: hierarchical name -> runtime value map.
+
+Reference parity: paddle/fluid/framework/scope.h:39-81 (Var / FindVar /
+NewScope / DropKids). Values are jax.Arrays (device-resident), LoDTensor
+wrappers, or host objects (readers, lod rank tables). Parameters and
+optimizer state persist here between Executor.run calls; on TPU they stay
+device-resident so steps never round-trip through host memory.
+"""
+
+from .lod_tensor import LoDTensor
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+        self.kids = []
+
+    def var(self, name):
+        """Find-or-create in THIS scope (reference Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return name
+
+    def find_var(self, name):
+        """Recursive lookup (reference Scope::FindVar). Returns value or None."""
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def set_var(self, name, value):
+        self._vars[name] = value
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def new_scope(self):
+        kid = Scope(parent=self)
+        self.kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self.kids = []
+
+    def local_var_names(self):
+        return list(self._vars.keys())
+
+    def find_tensor(self, name):
+        v = self.find_var(name)
+        if isinstance(v, LoDTensor):
+            return v
+        return v
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+def _switch_scope(scope):
+    _scope_stack.append(scope)
+    return scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        _scope_stack.append(scope)
+        try:
+            yield
+        finally:
+            _scope_stack.pop()
+
+    return _guard()

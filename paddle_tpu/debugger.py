@@ -1,0 +1,55 @@
+"""Program debugging / visualization (reference python/paddle/fluid/debuger.py
++ graphviz.py): human-readable program dump and graphviz export."""
+
+__all__ = ["pprint_program_codes", "pprint_block_codes", "draw_block_graphviz"]
+
+
+def pprint_block_codes(block, show_backward=False):
+    from .core.framework import OpRole, OP_ROLE_ATTR_NAME
+
+    lines = [f"# block {block.idx} (parent {block.parent_idx})"]
+    for v in block.vars.values():
+        kind = "param" if getattr(v, "trainable", None) is not None else "var"
+        lines.append(
+            f"{kind} {v.name} : shape={v.shape} dtype={v.dtype} "
+            f"persistable={v.persistable} lod={v.lod_level}"
+        )
+    for op in block.ops:
+        role = op.attrs.get(OP_ROLE_ATTR_NAME, OpRole.Forward)
+        if not show_backward and role not in (OpRole.Forward, OpRole.Forward | OpRole.Loss):
+            continue
+        outs = ", ".join(f"{k}={v}" for k, v in op.outputs.items())
+        ins = ", ".join(f"{k}={v}" for k, v in op.inputs.items())
+        lines.append(f"{outs} = {op.type}({ins})")
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program, show_backward=True):
+    return "\n\n".join(
+        pprint_block_codes(b, show_backward) for b in program.blocks
+    )
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+    """Emit a graphviz dot file of the block's dataflow."""
+    lines = ["digraph G {", "  rankdir=TB;"]
+    highlights = set(highlights or [])
+    for v in block.vars.values():
+        color = "red" if v.name in highlights else ("lightblue" if v.persistable else "white")
+        lines.append(
+            f'  "{v.name}" [shape=oval, style=filled, fillcolor={color}];'
+        )
+    for i, op in enumerate(block.ops):
+        op_node = f"op_{i}_{op.type}"
+        lines.append(f'  "{op_node}" [shape=box, label="{op.type}"];')
+        for n in op.input_arg_names():
+            if n:
+                lines.append(f'  "{n}" -> "{op_node}";')
+        for n in op.output_arg_names():
+            if n:
+                lines.append(f'  "{op_node}" -> "{n}";')
+    lines.append("}")
+    content = "\n".join(lines)
+    with open(path, "w") as f:
+        f.write(content)
+    return path

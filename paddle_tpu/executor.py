@@ -1,0 +1,191 @@
+"""Python Executor (reference python/paddle/fluid/executor.py:181).
+
+run() compiles the whole program into one XLA computation (see
+core/executor_core.py) and caches the compiled step keyed by
+(program identity+mutation, feed signature, fetch names). Programs containing
+host-side ops (save/load/print/readers/listen_and_serv) run in the eager
+interpret mode, matching the reference's op-by-op Executor semantics.
+"""
+
+import numpy as np
+import jax
+
+from .core import executor_core, registry
+from .core.framework import Program, Variable, default_main_program
+from .core.lod_tensor import LoDTensor
+from .core.places import CPUPlace, TPUPlace, jax_device_for
+from .core.scope import global_scope, Scope
+from .core.registry import SeqTensor
+
+__all__ = ["Executor", "global_scope", "scope_guard", "fetch_var"]
+
+from .core.scope import scope_guard  # re-export (reference executor.py:39)
+
+
+def as_numpy(tensor):
+    if isinstance(tensor, LoDTensor):
+        if tensor.lod():
+            return tensor  # ragged: return LoDTensor like the reference
+        return tensor.numpy()
+    if isinstance(tensor, (list, tuple)):
+        return [as_numpy(t) for t in tensor]
+    return np.asarray(tensor)
+
+
+def fetch_var(name, scope=None, return_numpy=True):
+    scope = scope or global_scope()
+    v = scope.find_var(name)
+    if v is None:
+        raise ValueError(f"Variable {name!r} is not found in scope")
+    if return_numpy:
+        if isinstance(v, SeqTensor):
+            return np.asarray(v.data)
+        return np.asarray(v)
+    return v
+
+
+def _program_has_host_ops(program):
+    for block in program.blocks:
+        for op in block.ops:
+            op_def = registry.get_op_def(op.type)
+            if op_def is not None and op_def.no_trace:
+                return True
+    return False
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place if place is not None else TPUPlace(0)
+        self._compile_cache = {}
+        self._step_counter = {}
+
+    # ------------------------------------------------------------------
+    def run(
+        self,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        feed_var_name="feed",
+        fetch_var_name="fetch",
+        scope=None,
+        return_numpy=True,
+        use_program_cache=True,
+    ):
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_names = [
+            v.name if isinstance(v, Variable) else str(v) for v in fetch_list
+        ]
+
+        if _program_has_host_ops(program):
+            outs = self._run_eager(program, scope, feed, fetch_names)
+        else:
+            outs = self._run_compiled(
+                program, scope, feed, fetch_names, use_program_cache
+            )
+        if return_numpy:
+            return [as_numpy(o) for o in outs]
+        return outs
+
+    # ------------------------------------------------------------------
+    def _feed_values(self, program, feed):
+        vals = {}
+        gb = program.global_block()
+        for name, value in feed.items():
+            var = gb.vars.get(name)
+            tv = executor_core.feed_to_tracevalue(value, var)
+            if var is not None and not isinstance(tv, SeqTensor):
+                want = var.dtype
+                if str(tv.dtype) != want and want is not None:
+                    tv = tv.astype(want)
+            vals[name] = tv
+        return vals
+
+    def _rng_for(self, program):
+        key = id(program)
+        step = self._step_counter.get(key, 0)
+        self._step_counter[key] = step + 1
+        return jax.random.fold_in(jax.random.PRNGKey(program.random_seed), step)
+
+    # ------------------------------------------------------------------
+    def _run_compiled(self, program, scope, feed, fetch_names, use_cache):
+        feed_vals = self._feed_values(program, feed)
+        state_names, state_out_names = executor_core.collect_state_names(program, scope)
+        cache_key = (
+            id(program),
+            program._mutation,
+            tuple(sorted((n, executor_core.spec_of(v)) for n, v in feed_vals.items())),
+            tuple(fetch_names),
+            tuple(state_names),
+        )
+        entry = self._compile_cache.get(cache_key) if use_cache else None
+        if entry is None:
+            step = executor_core.build_step_fn(program, fetch_names, state_out_names)
+            compiled = executor_core.compile_step_fn(step, donate_state=True)
+            entry = (compiled, state_names, state_out_names)
+            if use_cache:
+                self._compile_cache[cache_key] = entry
+        compiled, state_names, state_out_names = entry
+
+        mut_state = {}
+        const_state = {}
+        out_set = set(state_out_names)
+        for n in state_names:
+            v = scope.find_var(n)
+            if isinstance(v, LoDTensor):
+                v = executor_core.feed_to_tracevalue(v)
+            (mut_state if n in out_set else const_state)[n] = v
+        rng = self._rng_for(program)
+        fetches, new_mut = compiled(mut_state, const_state, feed_vals, rng)
+        for n, v in new_mut.items():
+            scope.set_var(n, v)
+        return [self._to_host(f) for f in fetches]
+
+    def _to_host(self, value):
+        if isinstance(value, SeqTensor):
+            return executor_core.value_to_lod_tensor(value)
+        return value
+
+    # ------------------------------------------------------------------
+    def _run_eager(self, program, scope, feed, fetch_names):
+        feed_vals = self._feed_values(program, feed)
+        env = {}
+        touched = set()
+        for b in program.blocks:
+            for op in b.ops:
+                touched.update(op.input_arg_names())
+                touched.update(op.output_arg_names())
+        for n in touched:
+            v = scope.find_var(n)
+            if v is not None:
+                env[n] = (
+                    executor_core.feed_to_tracevalue(v) if isinstance(v, LoDTensor) else v
+                )
+        env.update(feed_vals)
+        fetch_sink = []
+        ctx = executor_core.OpContext(
+            rng=self._rng_for(program),
+            eager=True,
+            scope=scope,
+            feed=feed_vals,
+            fetch_sink=fetch_sink,
+            place=self.place,
+        )
+        executor_core.run_ops(program.global_block().ops, env, ctx)
+        persistable = {
+            n
+            for blk in program.blocks
+            for n, v in blk.vars.items()
+            if v.persistable
+        }
+        for n in persistable & set(env.keys()):
+            scope.var(n)
+            scope.set_var(n, env[n])
+        outs = []
+        for n in fetch_names:
+            outs.append(self._to_host(executor_core.env_get(env, n)))
+        return outs

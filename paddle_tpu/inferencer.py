@@ -1,0 +1,60 @@
+"""Inferencer (reference python/paddle/fluid/inferencer.py)."""
+
+import contextlib
+
+from .core.framework import Program, program_guard
+from .core.scope import Scope, scope_guard
+from .executor import Executor
+from .parallel_executor import ParallelExecutor
+from .trainer import check_and_get_place
+from . import io as io_mod
+from . import unique_name
+
+__all__ = ["Inferencer"]
+
+
+class Inferencer:
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        self.param_path = param_path
+        self.scope = Scope()
+        self.parallel = parallel
+        self.place = check_and_get_place(place)
+
+        self.inference_program = Program()
+        with program_guard(self.inference_program):
+            with unique_name.guard():
+                self.predict_var = infer_func()
+
+        with scope_guard(self.scope):
+            self.exe = Executor(self.place)
+            io_mod.load_params(self.exe, param_path, self.inference_program)
+
+        if parallel:
+            with self._prog_and_scope_guard():
+                self.pe = ParallelExecutor(
+                    use_cuda=True, main_program=self.inference_program
+                )
+
+    def infer(self, inputs, return_numpy=True):
+        if not isinstance(inputs, dict):
+            raise ValueError("inputs should be a map of {'input_name': input_var}")
+        with self._prog_and_scope_guard():
+            if self.parallel:
+                results = self.pe.run(
+                    feed=inputs, fetch_list=[self.predict_var.name],
+                    return_numpy=return_numpy,
+                )
+            else:
+                results = self.exe.run(
+                    self.inference_program,
+                    feed=inputs,
+                    fetch_list=[self.predict_var],
+                    return_numpy=return_numpy,
+                )
+        return results
+
+    @contextlib.contextmanager
+    def _prog_and_scope_guard(self):
+        with program_guard(main_program=self.inference_program):
+            with scope_guard(self.scope):
+                yield

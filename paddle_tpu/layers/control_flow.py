@@ -1,0 +1,611 @@
+"""Control-flow layers (reference python/paddle/fluid/layers/control_flow.py):
+While:608, StaticRNN:383, DynamicRNN:1317, IfElse:1215, Switch:1126,
+ConditionalBlock:1069, lod_rank_table, array read/write, compare helpers.
+"""
+
+import contextlib
+
+from ..layer_helper import LayerHelper
+from ..core.framework import Variable, VarType
+from .. import unique_name
+from . import tensor as tensor_layers
+
+__all__ = [
+    "While", "Switch", "IfElse", "ConditionalBlock", "StaticRNN", "DynamicRNN",
+    "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
+    "array_to_lod_tensor", "increment", "array_write", "create_array",
+    "less_than", "equal", "array_read", "shrink_memory", "array_length",
+    "zeros_like", "reorder_lod_tensor_by_rank",
+]
+
+
+def less_than(x, y, cond=None, **ignored):
+    helper = LayerHelper("less_than", **locals())
+    if cond is None:
+        cond = helper.create_tmp_variable(dtype="bool", shape=x.shape)
+        cond.stop_gradient = True
+    helper.append_op("less_than", {"X": [x], "Y": [y]}, {"Out": [cond]})
+    return cond
+
+
+def equal(x, y, cond=None, **ignored):
+    helper = LayerHelper("equal", **locals())
+    if cond is None:
+        cond = helper.create_tmp_variable(dtype="bool", shape=x.shape)
+        cond.stop_gradient = True
+    helper.append_op("equal", {"X": [x], "Y": [y]}, {"Out": [cond]})
+    return cond
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment", **locals())
+    if not in_place:
+        out = helper.create_tmp_variable(dtype=x.dtype, shape=x.shape)
+    else:
+        out = x
+    helper.append_op("increment", {"X": [x]}, {"Out": [out]}, {"step": float(value)})
+    return out
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("zeros_like", **locals())
+    if out is None:
+        out = helper.create_tmp_variable(dtype=x.dtype, shape=x.shape)
+    helper.append_op("fill_zeros_like", {"X": [x]}, {"Out": [out]})
+    return out
+
+
+def create_array(dtype):
+    helper = LayerHelper("array")
+    return helper.create_variable(
+        name=unique_name.generate("array"), type=VarType.LOD_TENSOR_ARRAY, dtype=dtype
+    )
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write", **locals())
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op("write_to_array", {"X": [x], "I": [i]}, {"Out": [array]})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read", **locals())
+    out = helper.create_tmp_variable(dtype=array.dtype)
+    helper.append_op("read_from_array", {"X": [array], "I": [i]}, {"Out": [out]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length", **locals())
+    out = helper.create_tmp_variable(dtype="int64", shape=(1,))
+    out.stop_gradient = True
+    helper.append_op("lod_array_length", {"X": [array]}, {"Out": [out]})
+    return out
+
+
+def lod_rank_table(x, level=0):
+    helper = LayerHelper("lod_rank_table", **locals())
+    table = helper.create_variable(
+        name=unique_name.generate("lod_rank_table"), type=VarType.LOD_RANK_TABLE
+    )
+    helper.append_op("lod_rank_table", {"X": [x]}, {"Out": [table]}, {"level": level})
+    return table
+
+
+def max_sequence_len(rank_table):
+    helper = LayerHelper("max_seqence_len", **locals())
+    res = helper.create_tmp_variable(dtype="int64", shape=(1,))
+    res.stop_gradient = True
+    helper.append_op("max_sequence_len", {"RankTable": [rank_table]}, {"Out": [res]})
+    return res
+
+
+def lod_tensor_to_array(x, table):
+    helper = LayerHelper("lod_tensor_to_array", **locals())
+    array = helper.create_variable(
+        name=unique_name.generate("lod_tensor_to_array"),
+        type=VarType.LOD_TENSOR_ARRAY,
+        dtype=x.dtype,
+    )
+    helper.append_op(
+        "lod_tensor_to_array", {"X": [x], "RankTable": [table]}, {"Out": [array]}
+    )
+    return array
+
+
+def array_to_lod_tensor(x, table):
+    helper = LayerHelper("array_to_lod_tensor", **locals())
+    tmp = helper.create_tmp_variable(dtype=x.dtype, lod_level=1)
+    helper.append_op(
+        "array_to_lod_tensor", {"X": [x], "RankTable": [table]}, {"Out": [tmp]}
+    )
+    return tmp
+
+
+def shrink_memory(x, i, table):
+    helper = LayerHelper("shrink_memory", **locals())
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(
+        "shrink_rnn_memory", {"X": [x], "I": [i], "RankTable": [table]}, {"Out": [out]}
+    )
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    helper = LayerHelper("reorder_lod_tensor_by_rank", **locals())
+    out = helper.create_tmp_variable(dtype=x.dtype, lod_level=x.lod_level)
+    helper.append_op(
+        "reorder_lod_tensor_by_rank",
+        {"X": [x], "RankTable": [rank_table]},
+        {"Out": [out]},
+    )
+    return out
+
+
+class BlockGuard:
+    def __init__(self, main_program):
+        self.main_program = main_program
+
+    def __enter__(self):
+        self.main_program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.main_program.rollback()
+        return exc_type is None
+
+
+class While:
+    """reference control_flow.py:608 — lowers to lax.while_loop."""
+
+    BEFORE_WHILE_BLOCK = 0
+    IN_WHILE_BLOCK = 1
+    AFTER_WHILE_BLOCK = 2
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.status = While.BEFORE_WHILE_BLOCK
+        if not isinstance(cond, Variable):
+            raise TypeError("condition should be a variable")
+        self.cond_var = cond
+
+    def block(self):
+        return WhileGuard(self)
+
+    def complete(self, sub_block):
+        main_program = self.helper.main_program
+        parent_block = main_program.block(sub_block.parent_idx)
+        x_names = set()
+        for op in sub_block.ops:
+            x_names.update(op.input_arg_names())
+        inner = set()
+        for op in sub_block.ops:
+            inner.update(op.output_arg_names())
+        parent_block.append_op(
+            "while",
+            {"X": sorted(x_names - inner), "Condition": [self.cond_var]},
+            {"Out": [], "StepScopes": []},
+            {"sub_block": sub_block},
+        )
+
+
+class WhileGuard(BlockGuard):
+    def __init__(self, while_op):
+        super().__init__(while_op.helper.main_program)
+        self.while_op = while_op
+
+    def __enter__(self):
+        self.while_op.status = While.IN_WHILE_BLOCK
+        return super().__enter__()
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        sub_block = self.main_program.current_block()
+        res = super().__exit__(exc_type, exc_val, exc_tb)
+        self.while_op.status = While.AFTER_WHILE_BLOCK
+        self.while_op.complete(sub_block)
+        return res
+
+
+class ConditionalBlock:
+    """reference control_flow.py:1069 — lowers to lax.cond."""
+
+    def __init__(self, inputs, is_scalar_condition=False, name=None):
+        for each_input in inputs:
+            if not isinstance(each_input, Variable):
+                raise TypeError("Each input should be a Variable")
+        self.inputs = inputs
+        self.is_scalar_condition = is_scalar_condition
+        self.helper = LayerHelper("conditional_block", name=name)
+
+    def block(self):
+        return ConditionalBlockGuard(self)
+
+    def complete(self, sub_block):
+        main_program = self.helper.main_program
+        parent_block = main_program.block(sub_block.parent_idx)
+        parent_block.append_op(
+            "conditional_block",
+            {"X": self.inputs},
+            {"Out": [], "Scope": []},
+            {"sub_block": sub_block, "is_scalar_condition": self.is_scalar_condition},
+        )
+
+
+class ConditionalBlockGuard(BlockGuard):
+    def __init__(self, cond_block):
+        super().__init__(cond_block.helper.main_program)
+        self.cond_block = cond_block
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        sub_block = self.main_program.current_block()
+        res = super().__exit__(exc_type, exc_val, exc_tb)
+        self.cond_block.complete(sub_block)
+        return res
+
+
+class Switch:
+    """reference control_flow.py:1126 — chained conditional blocks."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.inside_scope = False
+        self.pre_not_conditions = []
+
+    def case(self, condition):
+        if not self.inside_scope:
+            raise ValueError("case should be called inside with")
+        from .ops import logical_and, logical_not
+
+        if len(self.pre_not_conditions) == 0:
+            cond_block = ConditionalBlock([condition], is_scalar_condition=True)
+            not_cond = logical_not(x=condition)
+            self.pre_not_conditions.append(not_cond)
+        else:
+            pre_cond_num = len(self.pre_not_conditions)
+            pre_not_cond = self.pre_not_conditions[pre_cond_num - 1]
+            new_not_cond = logical_and(x=pre_not_cond, y=logical_not(x=condition))
+            self.pre_not_conditions.append(new_not_cond)
+            cond_block = ConditionalBlock(
+                [logical_and(x=pre_not_cond, y=condition)], is_scalar_condition=True
+            )
+        return ConditionalBlockGuard(cond_block)
+
+    def default(self):
+        pre_cond_num = len(self.pre_not_conditions)
+        if pre_cond_num == 0:
+            raise ValueError("there should be at least one condition")
+        cond_block = ConditionalBlock(
+            [self.pre_not_conditions[pre_cond_num - 1]], is_scalar_condition=True
+        )
+        return ConditionalBlockGuard(cond_block)
+
+    def __enter__(self):
+        self.inside_scope = True
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.inside_scope = False
+        return exc_type is None
+
+
+class IfElse:
+    """reference control_flow.py:1215."""
+
+    OUT_IF_ELSE_BLOCKS = 0
+    IN_IF_ELSE_TRUE_BLOCKS = 1
+    IN_IF_ELSE_FALSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        if not isinstance(cond, Variable):
+            raise TypeError("cond must be a Variable")
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self.input_table = {}
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+        self.conditional_true_block = ConditionalBlock([cond])
+        from .ops import logical_not
+
+        self.not_cond = logical_not(cond)
+        self.conditional_false_block = ConditionalBlock([self.not_cond])
+        self.output_table = [[], []]  # [true_out, false_out]
+
+    def input(self, x):
+        # both branches see the full input (masking happens at output merge)
+        return x
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self.status = IfElse.IN_IF_ELSE_TRUE_BLOCKS
+        with self.conditional_true_block.block():
+            yield
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self.status = IfElse.IN_IF_ELSE_FALSE_BLOCKS
+        with self.conditional_false_block.block():
+            yield
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+
+    def output(self, *outs):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("output can only be invoked in the sub-block")
+        out_table = self.output_table[
+            1 if self.status == IfElse.IN_IF_ELSE_FALSE_BLOCKS else 0
+        ]
+        for each_out in outs:
+            out_table.append(each_out)
+
+    def __call__(self):
+        if self.status != self.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("IfElse::__call__ must be out of sub-block")
+        # merge: select per-row by condition
+        rlist = []
+        from .nn import multiplex
+        from . import tensor as T
+
+        for t_out, f_out in zip(self.output_table[0], self.output_table[1]):
+            idx = T.cast(self.cond, "int32")
+            rlist.append(multiplex([f_out, t_out], idx))
+        return rlist
+
+
+class StaticRNN:
+    """reference control_flow.py:383 — fixed-length RNN over time steps.
+
+    Built on a sub-block executed by the `recurrent` op, which lowers to
+    lax.scan (see ops/recurrent_op in control-flow kernels)."""
+
+    BEFORE_RNN_BLOCK = 0
+    IN_RNN_BLOCK = 1
+    AFTER_RNN_BLOCK = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.memories = {}  # mem var name -> (init var, pre_mem var, mem var)
+        self.inputs = []
+        self.outputs = []
+        self.status = StaticRNN.BEFORE_RNN_BLOCK
+        self.seq_len = None
+        self._sub_block = None
+
+    @contextlib.contextmanager
+    def step(self):
+        self.status = StaticRNN.IN_RNN_BLOCK
+        self.helper.main_program.create_block()
+        yield
+        self._sub_block = self.helper.main_program.current_block()
+        self.helper.main_program.rollback()
+        self.status = StaticRNN.AFTER_RNN_BLOCK
+        self._complete_op()
+
+    def _assert_in_rnn_block_(self, method):
+        if self.status != StaticRNN.IN_RNN_BLOCK:
+            raise ValueError(f"You must invoke {method} in rnn block")
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        self._assert_in_rnn_block_("memory")
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("if init is None, memory at least need shape and batch_ref")
+            parent_block = self._parent_block()
+            var_name = unique_name.generate("@".join([self.helper.name, "memory_boot"]))
+            boot_var = parent_block.create_var(
+                name=var_name, shape=shape, dtype=batch_ref.dtype, persistable=False
+            )
+            parent_block.append_op(
+                "fill_constant_batch_size_like",
+                {"Input": [batch_ref]},
+                {"Out": [boot_var]},
+                {
+                    "value": init_value,
+                    "shape": [1 if i == init_batch_dim_idx else s for i, s in enumerate(boot_var.shape)],
+                    "dtype": boot_var.dtype,
+                    "input_dim_idx": ref_batch_dim_idx,
+                    "output_dim_idx": init_batch_dim_idx,
+                },
+            )
+            return self.memory(init=boot_var)
+        pre_mem = self.helper.create_variable(
+            name=unique_name.generate("@".join([self.helper.name, "mem"])),
+            dtype=init.dtype,
+            shape=init.shape,
+        )
+        self.memories[pre_mem.name] = [init, pre_mem, None]
+        return pre_mem
+
+    def step_input(self, x):
+        self._assert_in_rnn_block_("step_input")
+        if self.seq_len is None:
+            self.seq_len = x.shape[0]
+        ipt = self.helper.create_variable(
+            name=unique_name.generate("@".join([self.helper.name, "step_in"])),
+            dtype=x.dtype,
+            shape=tuple(x.shape[1:]) if x.shape else None,
+        )
+        self.inputs.append((x, ipt))
+        return ipt
+
+    def step_output(self, o):
+        self._assert_in_rnn_block_("step_output")
+        self.outputs.append(o)
+
+    def output(self, *outputs):
+        for each in outputs:
+            self.step_output(each)
+
+    def update_memory(self, mem, var):
+        if not isinstance(mem, Variable) or not isinstance(var, Variable):
+            raise TypeError("update memory should take variables")
+        self.memories[mem.name][2] = var
+
+    def _parent_block(self):
+        prog = self.helper.main_program
+        return prog.block(self._sub_block.parent_idx) if self._sub_block else prog.current_block()
+
+    def _complete_op(self):
+        sub_block = self._sub_block
+        parent_block = self._parent_block()
+        step_inputs = [x for x, _ in self.inputs]
+        inner_inputs = [i for _, i in self.inputs]
+        boots = [self.memories[k][0] for k in self.memories]
+        pre_mems = [self.memories[k][1] for k in self.memories]
+        new_mems = [self.memories[k][2] for k in self.memories]
+        if any(m is None for m in new_mems):
+            raise ValueError("every memory needs update_memory")
+        step_outs = [
+            self.helper.create_variable(
+                name=unique_name.generate("@".join([self.helper.name, "out"])),
+                dtype=o.dtype,
+            )
+            for o in self.outputs
+        ]
+        self._outputs_vars = step_outs
+        parent_block.append_op(
+            "recurrent",
+            {
+                "inputs": step_inputs,
+                "initial_states": boots,
+            },
+            {"outputs": step_outs, "step_scopes": []},
+            {
+                "sub_block": sub_block,
+                "ex_states": [v.name for v in pre_mems],
+                "states": [v.name for v in new_mems],
+                "step_input_names": [v.name for v in inner_inputs],
+                "step_output_names": [o.name for o in self.outputs],
+            },
+        )
+
+    def __call__(self, *args, **kwargs):
+        if self.status != StaticRNN.AFTER_RNN_BLOCK:
+            raise ValueError("RNN output can only be retrieved after rnn block")
+        if not self.outputs:
+            raise ValueError("RNN has no output")
+        elif len(self.outputs) == 1:
+            return self._outputs_vars[0]
+        return self._outputs_vars
+
+
+class DynamicRNN:
+    """reference control_flow.py:1317 — variable-length RNN.
+
+    TPU-native lowering: instead of the reference's rank-table bucketing and
+    per-step shrinking batches, steps run over the padded [B,T,*] view with
+    per-step masks inside one lax.scan (`dynamic_recurrent` op); results are
+    re-raggedified. Same semantics, static shapes."""
+
+    BEFORE_RNN = 0
+    IN_RNN = 1
+    AFTER_RNN = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self.status = DynamicRNN.BEFORE_RNN
+        self.inputs = []  # (outer ragged var, inner step var)
+        self.static_inputs = []
+        self.memories = []  # (init or None, shape, value, pre_mem, new_mem)
+        self.outputs = []
+        self._sub_block = None
+        self._first_input = None
+
+    def step_input(self, x):
+        self._assert_in_rnn_block_("step_input")
+        if self._first_input is None:
+            self._first_input = x
+        ipt = self.helper.create_variable(
+            name=unique_name.generate("@".join([self.helper.name, "step_in"])),
+            dtype=x.dtype,
+        )
+        self.inputs.append((x, ipt))
+        return ipt
+
+    def static_input(self, x):
+        self._assert_in_rnn_block_("static_input")
+        self.static_inputs.append(x)
+        return x
+
+    @contextlib.contextmanager
+    def block(self):
+        if self.status != DynamicRNN.BEFORE_RNN:
+            raise ValueError("rnn.block() can only be invoked once")
+        self.status = DynamicRNN.IN_RNN
+        self.helper.main_program.create_block()
+        yield
+        self._sub_block = self.helper.main_program.current_block()
+        self.helper.main_program.rollback()
+        self.status = DynamicRNN.AFTER_RNN
+        self._complete_op()
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32"):
+        self._assert_in_rnn_block_("memory")
+        pre_mem = self.helper.create_variable(
+            name=unique_name.generate("@".join([self.helper.name, "mem"])),
+            dtype=init.dtype if init is not None else dtype,
+            shape=init.shape if init is not None else tuple([None] + list(shape or [])),
+        )
+        self.memories.append([init, shape, value, pre_mem, None])
+        return pre_mem
+
+    def update_memory(self, ex_mem, new_mem):
+        self._assert_in_rnn_block_("update_memory")
+        for m in self.memories:
+            if m[3] is ex_mem:
+                m[4] = new_mem
+                return
+        raise ValueError("unknown memory")
+
+    def output(self, *outputs):
+        self._assert_in_rnn_block_("output")
+        for o in outputs:
+            self.outputs.append(o)
+
+    def _assert_in_rnn_block_(self, method):
+        if self.status != DynamicRNN.IN_RNN:
+            raise ValueError(f"{method} can only be invoked inside rnn block")
+
+    def _complete_op(self):
+        sub_block = self._sub_block
+        parent_block = self.helper.main_program.block(sub_block.parent_idx)
+        outs = [
+            self.helper.create_variable(
+                name=unique_name.generate("@".join([self.helper.name, "out"])),
+                dtype=o.dtype,
+                lod_level=1,
+            )
+            for o in self.outputs
+        ]
+        self._outputs_vars = outs
+        parent_block.append_op(
+            "dynamic_recurrent",
+            {
+                "inputs": [x for x, _ in self.inputs],
+                "static_inputs": self.static_inputs,
+                "initial_states": [m[0] for m in self.memories if m[0] is not None],
+            },
+            {"outputs": outs},
+            {
+                "sub_block": sub_block,
+                "step_input_names": [i.name for _, i in self.inputs],
+                "mem_init_names": [m[0].name if m[0] is not None else "" for m in self.memories],
+                "mem_shapes": [list(m[1]) if m[1] else [] for m in self.memories],
+                "mem_values": [float(m[2]) for m in self.memories],
+                "pre_mem_names": [m[3].name for m in self.memories],
+                "new_mem_names": [m[4].name if m[4] is not None else "" for m in self.memories],
+                "step_output_names": [o.name for o in self.outputs],
+            },
+        )
+
+    def __call__(self, *args, **kwargs):
+        if self.status != DynamicRNN.AFTER_RNN:
+            raise ValueError("Dynamic RNN outputs can only be retrieved after rnn.block()")
+        if len(self._outputs_vars) == 1:
+            return self._outputs_vars[0]
+        return self._outputs_vars

@@ -1,0 +1,32 @@
+"""Detection layers (reference python/paddle/fluid/layers/detection.py):
+box_coder, iou_similarity, prior_box family. Round-1 coverage of the box
+utilities; SSD loss staged in ROADMAP.md.
+"""
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["box_coder", "iou_similarity"]
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True):
+    helper = LayerHelper("box_coder")
+    output_box = helper.create_tmp_variable(dtype=prior_box.dtype)
+    helper.append_op(
+        "box_coder",
+        {
+            "PriorBox": [prior_box],
+            "PriorBoxVar": [prior_box_var] if prior_box_var is not None else [],
+            "TargetBox": [target_box],
+        },
+        {"OutputBox": [output_box]},
+        {"code_type": code_type, "box_normalized": box_normalized},
+    )
+    return output_box
+
+
+def iou_similarity(x, y, box_normalized=True):
+    helper = LayerHelper("iou_similarity")
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op("iou_similarity", {"X": [x], "Y": [y]}, {"Out": [out]})
+    return out

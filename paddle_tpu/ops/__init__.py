@@ -1,0 +1,20 @@
+"""Operator library: JAX kernels for every op family the reference ships.
+
+Reference parity: paddle/fluid/operators/ (~170 op families, 437 files).
+Importing this package registers all kernels with core.registry. Each module
+header cites the reference files it covers.
+"""
+
+from . import util
+from . import math_ops
+from . import activation_ops
+from . import tensor_ops
+from . import nn_ops
+from . import optimizer_ops
+from . import sequence_ops
+from . import rnn_ops
+from . import control_flow_ops
+from . import io_ops
+from . import metric_ops
+from . import detection_ops
+from . import collective_ops

@@ -1,0 +1,54 @@
+"""Activation ops (reference operators/activation_op.cc — ~22 kernels).
+
+All are pure elementwise functions; gradients come from the registry's
+generic jax.vjp fallback, and XLA fuses them into neighbouring matmuls/convs
+(the reference needed hand-written grad functors per activation).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .util import first, out
+
+
+def _act(name, fn):
+    @register_op(name)
+    def _kernel(ctx, ins, attrs, _fn=fn):
+        return out(Out=_fn(first(ins, "X"), attrs))
+
+
+_act("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_act("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_act("exp", lambda x, a: jnp.exp(x))
+_act("relu", lambda x, a: jax.nn.relu(x))
+_act("tanh", lambda x, a: jnp.tanh(x))
+_act("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_act("softshrink", lambda x, a: jnp.sign(x) * jnp.maximum(jnp.abs(x) - a.get("lambda", 0.5), 0.0))
+_act("hard_shrink", lambda x, a: jnp.where(jnp.abs(x) > a.get("threshold", 0.5), x, 0.0))
+_act("sqrt", lambda x, a: jnp.sqrt(x))
+_act("abs", lambda x, a: jnp.abs(x))
+_act("ceil", lambda x, a: jnp.ceil(x))
+_act("floor", lambda x, a: jnp.floor(x))
+_act("round", lambda x, a: jnp.round(x))
+_act("cos", lambda x, a: jnp.cos(x))
+_act("sin", lambda x, a: jnp.sin(x))
+_act("reciprocal", lambda x, a: 1.0 / x)
+_act("log", lambda x, a: jnp.log(x))
+_act("square", lambda x, a: jnp.square(x))
+_act("softplus", lambda x, a: jax.nn.softplus(x))
+_act("softsign", lambda x, a: jax.nn.soft_sign(x))
+_act("brelu", lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)))
+_act("leaky_relu", lambda x, a: jnp.where(x >= 0, x, x * a.get("alpha", 0.02)))
+_act("soft_relu", lambda x, a: jnp.log1p(jnp.exp(jnp.clip(x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))))
+_act("elu", lambda x, a: jnp.where(x >= 0, x, a.get("alpha", 1.0) * (jnp.exp(x) - 1)))
+_act("relu6", lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)))
+_act("pow", lambda x, a: jnp.power(x, a.get("factor", 1.0)))
+_act("stanh", lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(a.get("scale_a", 2.0 / 3.0) * x))
+_act(
+    "hard_sigmoid",
+    lambda x, a: jnp.clip(a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+)
+_act("thresholded_relu", lambda x, a: jnp.where(x > a.get("threshold", 1.0), x, 0.0))
+_act("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+_act("gelu", lambda x, a: jax.nn.gelu(x))

@@ -1,0 +1,87 @@
+"""Collective ops over the device mesh.
+
+Reference parity: operators/nccl/nccl_op.cu.cc (AllReduce/Reduce/Bcast) and
+framework/details/nccl_all_reduce_op_handle.cc. TPU-native: these lower to
+jax.lax collectives (psum/pmean/all_gather/ppermute) which XLA schedules over
+ICI. Outside a mapped axis (single-device trace) they are identities — the
+same semantics the reference has with one device.
+
+The data-parallel gradient all-reduce itself is normally NOT emitted as ops:
+ParallelExecutor relies on pjit + sharding, and XLA inserts the collectives
+(SURVEY.md §2.4). These ops exist for explicit-collective programs and for
+shard_map-based custom parallel code.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .util import first, out
+
+
+def _in_mapped_axis(axis_name):
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+@register_op("all_reduce")
+def all_reduce_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis_name", "dp")
+    red = attrs.get("reduction", "sum")
+    if not _in_mapped_axis(axis):
+        return out(Out=x)
+    if red == "sum":
+        return out(Out=jax.lax.psum(x, axis))
+    if red == "mean":
+        return out(Out=jax.lax.pmean(x, axis))
+    if red == "max":
+        return out(Out=jax.lax.pmax(x, axis))
+    if red == "min":
+        return out(Out=jax.lax.pmin(x, axis))
+    raise ValueError(f"unknown reduction {red}")
+
+
+@register_op("all_gather")
+def all_gather_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis_name", "dp")
+    if not _in_mapped_axis(axis):
+        return out(Out=x)
+    return out(Out=jax.lax.all_gather(x, axis))
+
+
+@register_op("reduce_scatter")
+def reduce_scatter_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis_name", "dp")
+    if not _in_mapped_axis(axis):
+        return out(Out=x)
+    return out(Out=jax.lax.psum_scatter(x, axis, tiled=True))
+
+
+@register_op("broadcast")
+def broadcast_op(ctx, ins, attrs):
+    """NCCL bcast parity: in SPMD all replicas already hold the value; a
+    root-conditional select + psum implements true broadcast semantics."""
+    x = first(ins, "X")
+    axis = attrs.get("axis_name", "dp")
+    root = attrs.get("root", 0)
+    if not _in_mapped_axis(axis):
+        return out(Out=x)
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return out(Out=jax.lax.psum(masked, axis))
+
+
+@register_op("collective_permute")
+def collective_permute_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis_name", "dp")
+    perm = [tuple(p) for p in attrs["perm"]]
+    if not _in_mapped_axis(axis):
+        return out(Out=x)
+    return out(Out=jax.lax.ppermute(x, axis, perm))

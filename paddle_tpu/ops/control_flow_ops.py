@@ -1,0 +1,422 @@
+"""Control flow + compare/logical ops, feed/fetch, tensor-array ops.
+
+Reference parity: operators/{while,conditional_block,compare,logical,
+increment,lod_array_length,tensor_array_read_write,lod_tensor_to_array,
+array_to_lod_tensor,shrink_rnn_memory,max_sequence_len,print,assert}_op.cc
++ framework/lod_rank_table.cc, feed/fetch (framework/feed_fetch_method.cc).
+
+TPU mapping: `while` lowers to lax.while_loop over the sub-block trace;
+`conditional_block` to lax.cond; data-dependent python loops are therefore
+compiled, not interpreted. Tensor arrays become fixed-capacity stacked
+buffers (lod_tensor_to_array's bucketing is done by lod_rank_table on host
+lengths where possible, else via static max capacity).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, SeqTensor
+from .util import first, many, out
+
+
+# ---------------------------------------------------------------------------
+# compare / logical
+# ---------------------------------------------------------------------------
+def _cmp(fn):
+    def kernel(ctx, ins, attrs):
+        x, y = first(ins, "X"), first(ins, "Y")
+        return out(Out=fn(x, y))
+
+    return kernel
+
+
+for _name, _fn in [
+    ("less_than", jnp.less),
+    ("less_equal", jnp.less_equal),
+    ("greater_than", jnp.greater),
+    ("greater_equal", jnp.greater_equal),
+    ("equal", jnp.equal),
+    ("not_equal", jnp.not_equal),
+    ("logical_and", jnp.logical_and),
+    ("logical_or", jnp.logical_or),
+    ("logical_xor", jnp.logical_xor),
+]:
+    register_op(_name)(_cmp(_fn))
+
+
+@register_op("logical_not")
+def logical_not_op(ctx, ins, attrs):
+    return out(Out=jnp.logical_not(first(ins, "X")))
+
+
+# ---------------------------------------------------------------------------
+# while: lax.while_loop over the sub-block (reference while_op.cc:35)
+# ---------------------------------------------------------------------------
+@register_op("while", lod_aware=True)
+def while_op(ctx, ins, attrs):
+    op = ctx.current_op
+    env = ctx.env
+    block = attrs["sub_block"]
+    cond_name = op.input("Condition")[0]
+
+    written = []
+    seen = set()
+    for sub_op in block.ops:
+        for n in sub_op.output_arg_names():
+            if n and n not in seen:
+                seen.add(n)
+                written.append(n)
+    carried = [n for n in written if n in env]
+    if cond_name not in carried:
+        carried = [cond_name] + carried
+    # vars read by the sub-block but never written are closed over from env
+    carry_init = tuple(env[n] for n in carried)
+
+    def cond_fn(carry):
+        return carry[carried.index(cond_name)].reshape(())
+
+    def body_fn(carry):
+        local = dict(env)
+        local.update(dict(zip(carried, carry)))
+        ctx.run_block(block, local)
+        return tuple(local[n] for n in carried)
+
+    final = lax.while_loop(cond_fn, body_fn, carry_init)
+    env.update(dict(zip(carried, final)))
+    return {}
+
+
+@register_op("conditional_block", lod_aware=True)
+def conditional_block_op(ctx, ins, attrs):
+    """reference conditional_block_op.cc: run sub-block iff cond holds.
+    Lowered to lax.cond; the false branch passes through prior values (or
+    zeros when the var didn't exist yet)."""
+    op = ctx.current_op
+    env = ctx.env
+    block = attrs["sub_block"]
+    conds = [env[n] for n in op.input("X") if n in env]
+    cond = conds[0]
+    if attrs.get("is_scalar_condition", False):
+        pred = cond.reshape(())
+    else:
+        pred = jnp.all(cond)
+
+    written = []
+    seen = set()
+    for sub_op in block.ops:
+        for n in sub_op.output_arg_names():
+            if n and n not in seen:
+                seen.add(n)
+                written.append(n)
+
+    def true_fn(_):
+        local = dict(env)
+        ctx.run_block(block, local)
+        return tuple(local[n] for n in written)
+
+    out_shapes = jax.eval_shape(true_fn, 0)
+
+    def false_fn(_):
+        res = []
+        for n, s in zip(written, out_shapes):
+            if n in env:
+                res.append(env[n])
+            elif isinstance(s, SeqTensor):
+                res.append(SeqTensor(jnp.zeros(s.data.shape, s.data.dtype), jnp.zeros(s.lengths.shape, s.lengths.dtype)))
+            else:
+                res.append(jnp.zeros(s.shape, s.dtype))
+        return tuple(res)
+
+    result = lax.cond(pred, true_fn, false_fn, 0)
+    env.update(dict(zip(written, result)))
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (fixed-capacity stacked buffers)
+# ---------------------------------------------------------------------------
+class TensorArray:
+    """LOD_TENSOR_ARRAY runtime value: a python list during trace (each
+    element a traced array). Indexing by traced scalars uses stack+dyn-slice."""
+
+    def __init__(self, items=None):
+        self.items = list(items or [])
+
+    def write(self, i, value):
+        i = int(i) if not hasattr(i, "shape") else int(jax.device_get(i)) if not _is_traced(i) else None
+        if i is None:
+            raise NotImplementedError("traced-index tensor-array write inside jit region")
+        while len(self.items) <= i:
+            self.items.append(None)
+        self.items[i] = value
+
+    def read(self, i):
+        if _is_traced(i):
+            stacked = jnp.stack(self.items)
+            return jnp.take(stacked, i.astype(jnp.int32), axis=0)
+        return self.items[int(i) if not hasattr(i, "shape") else int(jax.device_get(i))]
+
+    def __len__(self):
+        return len(self.items)
+
+
+def _is_traced(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+@register_op("write_to_array", lod_aware=True)
+def write_to_array_op(ctx, ins, attrs):
+    op = ctx.current_op
+    env = ctx.env
+    x = first(ins, "X")
+    i = first(ins, "I")
+    out_name = op.output("Out")[0]
+    arr = env.get(out_name)
+    if not isinstance(arr, TensorArray):
+        arr = TensorArray()
+    arr.write(i, x)
+    env[out_name] = arr
+    return {}
+
+
+@register_op("read_from_array", lod_aware=True)
+def read_from_array_op(ctx, ins, attrs):
+    arr = first(ins, "X")
+    i = first(ins, "I")
+    return out(Out=arr.read(i))
+
+
+@register_op("lod_array_length")
+def lod_array_length_op(ctx, ins, attrs):
+    arr = first(ins, "X")
+    return out(Out=jnp.asarray([len(arr)], jnp.int64))
+
+
+@register_op("lod_rank_table", lod_aware=True)
+def lod_rank_table_op(ctx, ins, attrs):
+    """reference framework/lod_rank_table.cc: (seq index, length) sorted by
+    length desc — drives DynamicRNN bucketing."""
+    x = first(ins, "X")
+    lengths = x.lengths if isinstance(x, SeqTensor) else jnp.ones((x.shape[0],), jnp.int32)
+    order = jnp.argsort(-lengths, stable=True)
+    return out(Out=(order, jnp.take(lengths, order)))
+
+
+@register_op("max_sequence_len", lod_aware=True)
+def max_sequence_len_op(ctx, ins, attrs):
+    rank_table = first(ins, "RankTable")
+    order, lengths = rank_table
+    return out(Out=jnp.max(lengths).astype(jnp.int64))
+
+
+@register_op("lod_tensor_to_array", lod_aware=True)
+def lod_tensor_to_array_op(ctx, ins, attrs):
+    """Bucket a ragged batch into per-timestep arrays (DynamicRNN input).
+    Produces a TensorArray of [B_t, D] slices in rank-table order; B_t is the
+    number of sequences with length > t. Requires host-known lengths, so this
+    runs in the eager interpreter path (like the reference executor)."""
+    import numpy as np
+
+    x = first(ins, "X")
+    rank_table = first(ins, "RankTable")
+    order, lengths = rank_table
+    order = np.asarray(order)
+    lengths_np = np.asarray(lengths)
+    offs = np.zeros(len(order) + 1, np.int64)
+    all_len = np.asarray(x.lengths)
+    offs[1:] = np.cumsum(all_len)
+    T = int(lengths_np.max()) if len(lengths_np) else 0
+    arr = TensorArray()
+    for t in range(T):
+        rows = [offs[i] + t for i in order[lengths_np > t]]
+        arr.write(t, jnp.take(x.data, jnp.asarray(rows, jnp.int32), axis=0))
+    return out(Out=arr)
+
+
+@register_op("array_to_lod_tensor", lod_aware=True)
+def array_to_lod_tensor_op(ctx, ins, attrs):
+    import numpy as np
+
+    arr = first(ins, "X")
+    rank_table = first(ins, "RankTable")
+    order, lengths = rank_table
+    order_np = np.asarray(order)
+    lengths_np = np.asarray(lengths)
+    B = len(order_np)
+    chunks = {i: [] for i in range(B)}
+    for t in range(len(arr)):
+        item = arr.items[t]
+        live = [i for i in range(B) if lengths_np[i] > t]
+        for row, i in enumerate(live):
+            chunks[i].append(item[row])
+    seq_in_orig = {}
+    for rank_pos, orig_idx in enumerate(order_np):
+        seq_in_orig[int(orig_idx)] = chunks[rank_pos]
+    datas = []
+    lens = []
+    for i in range(B):
+        rows = seq_in_orig[i]
+        lens.append(len(rows))
+        if rows:
+            datas.append(jnp.stack(rows))
+    data = jnp.concatenate(datas, axis=0) if datas else jnp.zeros((0,))
+    return out(Out=SeqTensor(data, jnp.asarray(lens, jnp.int32)))
+
+
+@register_op("shrink_rnn_memory", lod_aware=True)
+def shrink_rnn_memory_op(ctx, ins, attrs):
+    """Shrink memory batch to sequences still alive at step I."""
+    import numpy as np
+
+    x = first(ins, "X")
+    i = first(ins, "I")
+    rank_table = first(ins, "RankTable")
+    order, lengths = rank_table
+    t = int(np.asarray(i).reshape(-1)[0])
+    alive = int((np.asarray(lengths) > t).sum())
+    return out(Out=x[:alive])
+
+
+# ---------------------------------------------------------------------------
+# feed / fetch / print / asserts
+# ---------------------------------------------------------------------------
+@register_op("feed", no_trace=True, lod_aware=True)
+def feed_op(ctx, ins, attrs):
+    op = ctx.current_op
+    col = attrs.get("col", 0)
+    name = op.output("Out")[0]
+    # feed values are keyed by target var name in this build
+    val = ctx.feed.get(name)
+    if val is None:
+        vals = list(ctx.feed.values())
+        val = vals[col] if col < len(vals) else None
+    return out(Out=val)
+
+
+@register_op("fetch", no_trace=True, lod_aware=True)
+def fetch_op(ctx, ins, attrs):
+    ctx.fetch_sink.append(first(ins, "X"))
+    return {}
+
+
+@register_op("print", lod_aware=True)
+def print_op(ctx, ins, attrs):
+    """reference print_op.cc — uses jax.debug.print so it works inside the
+    compiled step (the reference had to run it on the host)."""
+    x = first(ins, "In")
+    msg = attrs.get("message", "")
+    data = x.data if isinstance(x, SeqTensor) else x
+    jax.debug.print(msg + " {}", data)
+    return out(Out=x)
+
+
+@register_op("assert_op")
+def assert_op(ctx, ins, attrs):
+    return {}
+
+
+@register_op("get_places", no_trace=True)
+def get_places_op(ctx, ins, attrs):
+    from ..core import places as places_mod
+
+    count = attrs.get("device_count", 0) or places_mod.accelerator_count() or 1
+    device_type = attrs.get("device_type", "AUTO")
+    if device_type == "CPU":
+        plist = [places_mod.CPUPlace()] * count
+    else:
+        plist = [places_mod.TPUPlace(i) for i in range(count)]
+    return out(Out=plist)
+
+
+# ---------------------------------------------------------------------------
+# recurrent (StaticRNN) + dynamic_recurrent (DynamicRNN): lax.scan lowering
+# of the reference's recurrent_op.cc StepScopes machinery.
+# ---------------------------------------------------------------------------
+@register_op("recurrent", lod_aware=True)
+def recurrent_op(ctx, ins, attrs):
+    op = ctx.current_op
+    env = ctx.env
+    block = attrs["sub_block"]
+    step_input_names = attrs["step_input_names"]
+    ex_states = attrs["ex_states"]
+    states = attrs["states"]
+    step_output_names = attrs["step_output_names"]
+
+    xs = [env[n] for n in op.input("inputs")]  # each [T, ...]
+    boots = [env[n] for n in op.input("initial_states")]
+
+    def body(carry, x_t):
+        local = dict(env)
+        local.update(dict(zip(ex_states, carry)))
+        local.update(dict(zip(step_input_names, x_t)))
+        ctx.run_block(block, local)
+        new_carry = tuple(local[n] for n in states)
+        ys = tuple(local[n] for n in step_output_names)
+        return new_carry, ys
+
+    _, ys = lax.scan(body, tuple(boots), tuple(xs))
+    env.update(dict(zip(op.output("outputs"), ys)))
+    return {}
+
+
+@register_op("dynamic_recurrent", lod_aware=True)
+def dynamic_recurrent_op(ctx, ins, attrs):
+    """DynamicRNN: padded masked scan over ragged inputs.
+
+    The reference shrinks the live batch per step via rank-table bucketing
+    (control_flow.py:1317, recurrent_op.cc StepScopes); on TPU we keep a
+    static [B] batch and mask finished sequences — same math, fixed shapes.
+    """
+    from .sequence_ops import seq_to_padded, padded_to_seq
+
+    op = ctx.current_op
+    env = ctx.env
+    block = attrs["sub_block"]
+    step_input_names = attrs["step_input_names"]
+    pre_mem_names = attrs["pre_mem_names"]
+    new_mem_names = attrs["new_mem_names"]
+    mem_init_names = attrs["mem_init_names"]
+    mem_shapes = attrs["mem_shapes"]
+    mem_values = attrs["mem_values"]
+    step_output_names = attrs["step_output_names"]
+
+    seq_ins = [env[n] for n in op.input("inputs")]
+    assert seq_ins and isinstance(seq_ins[0], SeqTensor), "DynamicRNN needs ragged inputs"
+    lengths = seq_ins[0].lengths
+    B = int(lengths.shape[0])
+    ntokens = seq_ins[0].ntokens
+    T = ntokens  # conservative static bound; bucketing trims this upstream
+    padded = [jnp.swapaxes(seq_to_padded(s, T), 0, 1) for s in seq_ins]  # [T,B,*]
+
+    boots = []
+    for i, name in enumerate(pre_mem_names):
+        if mem_init_names[i]:
+            boots.append(env[mem_init_names[i]])
+        else:
+            shape = [B] + list(mem_shapes[i])
+            boots.append(jnp.full(shape, mem_values[i], padded[0].dtype))
+
+    ts = jnp.arange(T)
+
+    def body(carry, inp):
+        x_ts, t = inp
+        local = dict(env)
+        local.update(dict(zip(pre_mem_names, carry)))
+        local.update(dict(zip(step_input_names, x_ts)))
+        ctx.run_block(block, local)
+        mask = (t < lengths).astype(padded[0].dtype)
+        new_carry = []
+        for i, nm in enumerate(new_mem_names):
+            new_v = local[nm] if nm else carry[i]
+            m = mask.reshape((B,) + (1,) * (new_v.ndim - 1))
+            new_carry.append(m * new_v + (1 - m) * carry[i])
+        ys = tuple(local[n] for n in step_output_names)
+        return tuple(new_carry), ys
+
+    _, ys = lax.scan(body, tuple(boots), (tuple(padded), ts))
+    # re-raggedify each output: ys[i] is [T,B,*] -> SeqTensor aligned to input
+    for out_name, y in zip(op.output("outputs"), ys):
+        y_bt = jnp.swapaxes(y, 0, 1)  # [B,T,*]
+        env[out_name] = padded_to_seq(y_bt, lengths, ntokens)
+    return {}

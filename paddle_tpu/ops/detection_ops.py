@@ -1,0 +1,114 @@
+"""Image/detection ops.
+
+Reference parity: operators/{roi_pool,box_coder,iou_similarity,prior_box,
+multiclass_nms(detection/),bipartite_match,mine_hard_examples,ssd_loss}.
+Round-1 coverage: roi_pool + box utilities; the SSD loss pipeline is staged
+for a later round (tracked in ROADMAP.md).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op, set_stop_gradient_outputs
+from .util import first, out
+
+
+@register_op("roi_pool")
+def roi_pool_op(ctx, ins, attrs):
+    """reference operators/roi_pool_op.cc — max pool over ROI grid."""
+    x = first(ins, "X")  # [N,C,H,W]
+    rois = first(ins, "ROIs")  # [R,5] (batch_idx,x1,y1,x2,y2) or [R,4]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    if rois.shape[-1] == 5:
+        batch_idx = rois[:, 0].astype(jnp.int32)
+        boxes = rois[:, 1:]
+    else:
+        batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+        boxes = rois
+
+    def pool_one(bi, box):
+        x1 = jnp.round(box[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(box[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(box[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(box[3] * scale).astype(jnp.int32)
+        roi_h = jnp.maximum(y2 - y1 + 1, 1)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1)
+        img = x[bi]  # [C,H,W]
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+
+        def cell(py, px):
+            hstart = y1 + (py * roi_h) // ph
+            hend = y1 + ((py + 1) * roi_h + ph - 1) // ph
+            wstart = x1 + (px * roi_w) // pw
+            wend = x1 + ((px + 1) * roi_w + pw - 1) // pw
+            m = (
+                (ys[:, None] >= hstart)
+                & (ys[:, None] < jnp.maximum(hend, hstart + 1))
+                & (xs[None, :] >= wstart)
+                & (xs[None, :] < jnp.maximum(wend, wstart + 1))
+            )
+            neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+            return jnp.max(jnp.where(m[None], img, neg), axis=(1, 2))
+
+        grid = jax.vmap(lambda py: jax.vmap(lambda px: cell(py, px))(jnp.arange(pw)))(
+            jnp.arange(ph)
+        )  # [ph,pw,C]
+        return jnp.transpose(grid, (2, 0, 1))
+
+    o = jax.vmap(pool_one)(batch_idx, boxes)
+    return out(Out=o, Argmax=jnp.zeros(o.shape, jnp.int64))
+
+
+set_stop_gradient_outputs("roi_pool", ["Argmax"])
+
+
+@register_op("iou_similarity")
+def iou_similarity_op(ctx, ins, attrs):
+    a, b = first(ins, "X"), first(ins, "Y")  # [N,4], [M,4]
+    area = lambda t: jnp.maximum(t[:, 2] - t[:, 0], 0) * jnp.maximum(t[:, 3] - t[:, 1], 0)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area(a)[:, None] + area(b)[None, :] - inter
+    return out(Out=inter / jnp.maximum(union, 1e-10))
+
+
+@register_op("box_coder")
+def box_coder_op(ctx, ins, attrs):
+    prior = first(ins, "PriorBox")  # [M,4]
+    prior_var = first(ins, "PriorBoxVar")
+    target = first(ins, "TargetBox")
+    code_type = attrs.get("code_type", "encode_center_size")
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    var = prior_var if prior_var is not None else jnp.ones_like(prior)
+    if code_type.startswith("encode"):
+        tw = target[:, 2] - target[:, 0]
+        th = target[:, 3] - target[:, 1]
+        tcx = target[:, 0] + 0.5 * tw
+        tcy = target[:, 1] + 0.5 * th
+        o = jnp.stack(
+            [
+                (tcx[:, None] - pcx[None]) / pw[None] / var[None, :, 0],
+                (tcy[:, None] - pcy[None]) / ph[None] / var[None, :, 1],
+                jnp.log(jnp.maximum(tw[:, None] / pw[None], 1e-10)) / var[None, :, 2],
+                jnp.log(jnp.maximum(th[:, None] / ph[None], 1e-10)) / var[None, :, 3],
+            ],
+            axis=-1,
+        )
+    else:
+        t = target.reshape(-1, prior.shape[0], 4)
+        ocx = pcx + t[..., 0] * var[:, 0] * pw
+        ocy = pcy + t[..., 1] * var[:, 1] * ph
+        ow = jnp.exp(t[..., 2] * var[:, 2]) * pw
+        oh = jnp.exp(t[..., 3] * var[:, 3]) * ph
+        o = jnp.stack([ocx - 0.5 * ow, ocy - 0.5 * oh, ocx + 0.5 * ow, ocy + 0.5 * oh], axis=-1)
+    return out(OutputBox=o)

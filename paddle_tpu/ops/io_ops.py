@@ -1,0 +1,101 @@
+"""Host-side IO ops: save/load (+combine), uniform with the reference's
+operators/{save,load,save_combine,load_combine}_op.cc tensor files.
+
+Serialization format: numpy .npy written with a small JSON sidecar for lod —
+readable without the framework. save_combine packs multiple vars into one
+.npz. These ops run in the eager interpreter path (no_trace).
+"""
+
+import json
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.registry import register_op, SeqTensor
+from .util import first, many, out
+
+
+def _to_numpy(v):
+    if isinstance(v, SeqTensor):
+        return np.asarray(v.data), np.asarray(v.lengths)
+    return np.asarray(v), None
+
+
+def _save_one(path, v):
+    data, lengths = _to_numpy(v)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.save(path + ".npy", data, allow_pickle=False)
+    if lengths is not None:
+        with open(path + ".lod.json", "w") as f:
+            json.dump({"lengths": lengths.tolist()}, f)
+
+
+def _load_one(path):
+    data = np.load(path + ".npy", allow_pickle=False)
+    lod_path = path + ".lod.json"
+    if os.path.exists(lod_path):
+        with open(lod_path) as f:
+            lengths = json.load(f)["lengths"]
+        return SeqTensor(jnp.asarray(data), jnp.asarray(lengths, jnp.int32))
+    return jnp.asarray(data)
+
+
+@register_op("save", no_trace=True, lod_aware=True)
+def save_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    path = attrs["file_path"]
+    if os.path.exists(path + ".npy") and not attrs.get("overwrite", True):
+        raise RuntimeError(f"{path} exists and overwrite=False")
+    _save_one(path, x)
+    return {}
+
+
+@register_op("load", no_trace=True, lod_aware=True)
+def load_op(ctx, ins, attrs):
+    return out(Out=_load_one(attrs["file_path"]))
+
+
+@register_op("save_combine", no_trace=True, lod_aware=True)
+def save_combine_op(ctx, ins, attrs):
+    op = ctx.current_op
+    xs = many(ins, "X")
+    names = op.input("X")
+    path = attrs["file_path"]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arrays = {}
+    for n, v in zip(names, xs):
+        data, lengths = _to_numpy(v)
+        arrays[n] = data
+        if lengths is not None:
+            arrays[n + "@@lod"] = lengths
+    np.savez(path, **arrays)
+    if not path.endswith(".npz"):
+        os.replace(path + ".npz", path)
+    return {}
+
+
+@register_op("load_combine", no_trace=True, lod_aware=True)
+def load_combine_op(ctx, ins, attrs):
+    op = ctx.current_op
+    path = attrs["file_path"]
+    z = np.load(path, allow_pickle=False)
+    names = op.output("Out")
+    vals = []
+    for n in names:
+        data = z[n]
+        if n + "@@lod" in z:
+            vals.append(SeqTensor(jnp.asarray(data), jnp.asarray(z[n + "@@lod"], jnp.int32)))
+        else:
+            vals.append(jnp.asarray(data))
+    return out(Out=vals)
+
+
+@register_op("delete_var", no_trace=True, lod_aware=True)
+def delete_var_op(ctx, ins, attrs):
+    op = ctx.current_op
+    for n in op.input("X"):
+        ctx.env.pop(n, None)
+        if ctx.scope is not None:
+            ctx.scope.erase(n)
+    return {}

@@ -1,0 +1,201 @@
+"""Math ops: matmul/mul/fc core, elementwise family, reductions, misc math.
+
+Reference parity: operators/mul_op.cc, matmul_op.cc, elementwise_*_op.cc,
+reduce_op.cc, sum_op.cc, scale_op.cc, mean_op.cc, clip_op.cc, cumsum,
+cos_sim_op.cc, l2_normalize (via layers), topk_op.cc, cross-op math in
+operators/math/blas.h (GEMM -> MXU-shaped jnp.matmul / lax.dot_general;
+accumulation in float32 via preferred_element_type for bf16 inputs).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, register_grad_maker
+from .util import first, many, out, bcast_y_to_x
+
+
+def _matmul(a, b):
+    # Keep MXU-friendly: accumulate bf16 matmuls in f32.
+    pref = jnp.float32 if a.dtype in (jnp.bfloat16, jnp.float16) else None
+    return jnp.matmul(a, b, preferred_element_type=pref).astype(
+        a.dtype if pref else jnp.result_type(a, b)
+    )
+
+
+@register_op("mul")
+def mul_op(ctx, ins, attrs):
+    """reference operators/mul_op.cc — flatten-to-2D matmul (the fc core)."""
+    x, y = first(ins, "X"), first(ins, "Y")
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    import math
+
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape(math.prod(xs[:xn]) if xn else 1, -1)
+    y2 = y.reshape(-1, math.prod(ys[yn:]) if yn < len(ys) else 1)
+    o = _matmul(x2, y2)
+    return out(Out=o.reshape(tuple(xs[:xn]) + tuple(ys[yn:])))
+
+
+@register_op("matmul")
+def matmul_op(ctx, ins, attrs):
+    """reference operators/matmul_op.cc (batched, transpose flags)."""
+    x, y = first(ins, "X"), first(ins, "Y")
+    tx, ty = attrs.get("transpose_X", False), attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    squeeze_x = squeeze_y = False
+    if x.ndim == 1:
+        x, squeeze_x = x[None, :], True
+    if y.ndim == 1:
+        y, squeeze_y = y[:, None], True
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    o = _matmul(x, y)
+    if squeeze_x:
+        o = o.squeeze(-2)
+    if squeeze_y:
+        o = o.squeeze(-1)
+    if alpha != 1.0:
+        o = o * alpha
+    return out(Out=o)
+
+
+def _ew(fn):
+    def kernel(ctx, ins, attrs):
+        x, y = first(ins, "X"), first(ins, "Y")
+        yb = bcast_y_to_x(x, y, attrs.get("axis", -1))
+        return out(Out=fn(x, yb))
+
+    return kernel
+
+
+for _name, _fn in [
+    ("elementwise_add", jnp.add),
+    ("elementwise_sub", jnp.subtract),
+    ("elementwise_mul", jnp.multiply),
+    ("elementwise_div", jnp.divide),
+    ("elementwise_max", jnp.maximum),
+    ("elementwise_min", jnp.minimum),
+    ("elementwise_pow", jnp.power),
+]:
+    register_op(_name)(_ew(_fn))
+
+
+@register_op("sum")
+def sum_op(ctx, ins, attrs):
+    """reference operators/sum_op.cc — add N tensors (grad accumulation)."""
+    xs = many(ins, "X")
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = acc + x
+    return out(Out=acc)
+
+
+@register_op("scale")
+def scale_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    after = attrs.get("bias_after_scale", True)
+    o = x * s + b if after else (x + b) * s
+    return out(Out=o.astype(x.dtype))
+
+
+@register_op("mean")
+def mean_op(ctx, ins, attrs):
+    return out(Out=jnp.mean(first(ins, "X")))
+
+
+def _reduce(fn):
+    def kernel(ctx, ins, attrs):
+        x = first(ins, "X")
+        dim = attrs.get("dim", None)
+        keep = attrs.get("keep_dim", False)
+        if attrs.get("reduce_all", False) or dim is None:
+            axis = None
+        else:
+            axis = tuple(d % x.ndim for d in (dim if isinstance(dim, (list, tuple)) else [dim]))
+        return out(Out=fn(x, axis=axis, keepdims=keep))
+
+    return kernel
+
+
+for _name, _fn in [
+    ("reduce_sum", jnp.sum),
+    ("reduce_mean", jnp.mean),
+    ("reduce_max", jnp.max),
+    ("reduce_min", jnp.min),
+    ("reduce_prod", jnp.prod),
+]:
+    register_op(_name)(_reduce(_fn))
+
+
+@register_op("clip")
+def clip_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    return out(Out=jnp.clip(x, attrs["min"], attrs["max"]))
+
+
+@register_op("clip_by_norm")
+def clip_by_norm_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return out(Out=x * scale.astype(x.dtype))
+
+
+@register_op("cos_sim")
+def cos_sim_op(ctx, ins, attrs):
+    """reference operators/cos_sim_op.cc; Y may be a single row broadcast."""
+    x, y = first(ins, "X"), first(ins, "Y")
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    num = jnp.sum(x * y, axis=-1, keepdims=True)
+    o = num / jnp.maximum(xn * yn, 1e-12)
+    return out(Out=o, XNorm=xn, YNorm=yn)
+
+
+@register_op("cumsum")
+def cumsum_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis", -1)
+    exclusive = attrs.get("exclusive", False)
+    reverse = attrs.get("reverse", False)
+    if reverse:
+        x = jnp.flip(x, axis)
+    o = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        o = o - x
+    if reverse:
+        o = jnp.flip(o, axis)
+    return out(Out=o)
+
+
+@register_op("top_k")
+def top_k_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    k = attrs["k"]
+    vals, idx = lax.top_k(x, k)
+    return out(Out=vals, Indices=idx.astype(jnp.int64))
+
+
+@register_op("maxout")
+def maxout_op(ctx, ins, attrs):
+    x = first(ins, "X")  # NCHW
+    groups = attrs["groups"]
+    n, c, h, w = x.shape
+    o = x.reshape(n, c // groups, groups, h, w).max(axis=2)
+    return out(Out=o)
+
+
+@register_op("norm")
+def norm_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis", 1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return out(Out=x / norm, Norm=norm)

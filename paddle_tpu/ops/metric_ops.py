@@ -1,0 +1,211 @@
+"""Metric ops: accuracy, auc, precision/recall, edit distance, chunk eval.
+
+Reference parity: operators/{accuracy,auc,precision_recall,edit_distance,
+chunk_eval}_op.cc.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, set_stop_gradient_outputs, SeqTensor
+from .util import first, out
+
+
+@register_op("accuracy")
+def accuracy_op(ctx, ins, attrs):
+    """Out(Indices of top-k) vs Label."""
+    indices = first(ins, "Indices")
+    label = first(ins, "Label")
+    label = label.reshape(label.shape[0], 1)
+    correct = jnp.any(indices == label, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = jnp.asarray(indices.shape[0], jnp.int32)
+    acc = num_correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return out(Accuracy=acc, Correct=num_correct, Total=total)
+
+
+set_stop_gradient_outputs("accuracy", ["Accuracy", "Correct", "Total"])
+
+
+@register_op("auc")
+def auc_op(ctx, ins, attrs):
+    """Streaming AUC via fixed histogram buckets (reference auc_op.cc)."""
+    predict = first(ins, "Predict")
+    label = first(ins, "Label").reshape(-1)
+    stat_pos = first(ins, "StatPos")
+    stat_neg = first(ins, "StatNeg")
+    num_thresholds = attrs.get("num_thresholds", 200)
+    pos_prob = predict[:, 1] if predict.ndim == 2 and predict.shape[1] == 2 else predict.reshape(-1)
+    bucket = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0, num_thresholds)
+    is_pos = (label > 0).astype(jnp.float32)
+    pos_hist = jax.ops.segment_sum(is_pos, bucket, num_segments=num_thresholds + 1)
+    neg_hist = jax.ops.segment_sum(1.0 - is_pos, bucket, num_segments=num_thresholds + 1)
+    new_pos = stat_pos + pos_hist
+    new_neg = stat_neg + neg_hist
+    # trapezoid over descending threshold
+    tp = jnp.cumsum(new_pos[::-1])
+    fp = jnp.cumsum(new_neg[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tpr = tp / jnp.maximum(tot_pos, 1.0)
+    fpr = fp / jnp.maximum(tot_neg, 1.0)
+    auc = jnp.trapezoid(tpr, fpr)
+    return out(AUC=auc, StatPosOut=new_pos, StatNegOut=new_neg)
+
+
+set_stop_gradient_outputs("auc", ["AUC", "StatPosOut", "StatNegOut"])
+
+
+@register_op("precision_recall")
+def precision_recall_op(ctx, ins, attrs):
+    max_probs = first(ins, "MaxProbs")
+    indices = first(ins, "Indices").reshape(-1)
+    labels = first(ins, "Labels").reshape(-1)
+    weights = first(ins, "Weights")
+    states = first(ins, "StatesInfo")
+    cls_num = attrs["class_number"]
+    w = weights.reshape(-1) if weights is not None else jnp.ones_like(labels, jnp.float32)
+    idx = indices.astype(jnp.int32)
+    lab = labels.astype(jnp.int32)
+    correct = (idx == lab).astype(jnp.float32) * w
+    tp = jax.ops.segment_sum(correct, lab, num_segments=cls_num)
+    fp = jax.ops.segment_sum(w * (idx != lab).astype(jnp.float32), idx, num_segments=cls_num)
+    fn = jax.ops.segment_sum(w * (idx != lab).astype(jnp.float32), lab, num_segments=cls_num)
+    tn_total = jnp.sum(w) - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn_total, fn], axis=1)
+    acc_states = (states if states is not None else 0) + batch_states
+
+    def metrics(st):
+        tp_, fp_, tn_, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12), 0.0)
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0)
+        return jnp.asarray([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+
+    batch_metrics = jnp.concatenate([metrics(batch_states), metrics(acc_states)])
+    return out(
+        BatchMetrics=batch_metrics[:3],
+        AccumMetrics=batch_metrics[3:],
+        AccumStatesInfo=acc_states,
+    )
+
+
+@register_op("edit_distance", lod_aware=True)
+def edit_distance_op(ctx, ins, attrs):
+    """Levenshtein distance between hyp/ref token sequences (per pair).
+
+    Computed with a dynamic-programming scan over the (padded) hyp axis —
+    wavefront DP, each row vectorized on device.
+    """
+    hyp = first(ins, "Hyps")
+    ref = first(ins, "Refs")
+    normalized = attrs.get("normalized", True)
+
+    def to_padded(x):
+        from .sequence_ops import seq_to_padded
+
+        if isinstance(x, SeqTensor):
+            T = int(x.ntokens)
+            return seq_to_padded(x, T).reshape(x.batch, T, -1)[:, :, 0], x.lengths
+        return x.reshape(x.shape[0], -1), jnp.full((x.shape[0],), x.shape[-1], jnp.int32)
+
+    h, hlen = to_padded(hyp)
+    r, rlen = to_padded(ref)
+    B, Th = h.shape
+    Tr = r.shape[1]
+
+    # dp over ref positions: dp[j] = edit distance hyp[:i] vs ref[:j]
+    def per_pair(hrow, rrow, hl, rl):
+        init = jnp.arange(Tr + 1, dtype=jnp.float32)
+
+        def body(i, dp):
+            ins_cost = dp[:-1] + (hrow[i] != rrow).astype(jnp.float32)
+            left = jnp.concatenate([jnp.asarray([i + 1.0]), jnp.zeros((Tr,))])
+
+            def inner(j, row):
+                val = jnp.minimum(
+                    jnp.minimum(row[j] + 1.0, dp[j + 1] + 1.0), ins_cost[j]
+                )
+                return row.at[j + 1].set(val)
+
+            row = lax.fori_loop(0, Tr, inner, left)
+            return jnp.where(i < hl, row, dp)
+
+        dp = lax.fori_loop(0, Th, body, init)
+        d = dp[rl]
+        return d
+
+    dist = jax.vmap(per_pair)(h, r, hlen, rlen)
+    seq_num = jnp.asarray(B, jnp.int64)
+    if normalized:
+        dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+    return out(Out=dist.reshape(B, 1), SequenceNum=seq_num)
+
+
+set_stop_gradient_outputs("edit_distance", ["Out", "SequenceNum"])
+
+
+@register_op("chunk_eval", lod_aware=True, no_trace=True)
+def chunk_eval_op(ctx, ins, attrs):
+    """reference chunk_eval_op.cc (IOB chunking P/R/F1). Host-side numpy
+    implementation (evaluation only, not in the training hot path)."""
+    import numpy as np
+
+    inference = first(ins, "Inference")
+    label = first(ins, "Label")
+    num_chunk_types = attrs["num_chunk_types"]
+    scheme = attrs.get("chunk_scheme", "IOB")
+    excluded = set(attrs.get("excluded_chunk_types", []))
+
+    def get_chunks(tags, lengths):
+        tags = np.asarray(tags).reshape(-1)
+        chunks = []
+        pos = 0
+        for L in np.asarray(lengths):
+            seq = tags[pos : pos + L]
+            start = None
+            ctype = None
+            for i, t in enumerate(seq):
+                t = int(t)
+                if scheme == "IOB":
+                    tag_type = t // 2 if t < 2 * num_chunk_types else -1
+                    is_begin = t % 2 == 0 and t < 2 * num_chunk_types
+                    is_inside = t % 2 == 1 and t < 2 * num_chunk_types
+                else:
+                    tag_type, is_begin, is_inside = -1, False, False
+                if is_begin:
+                    if start is not None:
+                        chunks.append((pos + start, pos + i - 1, ctype))
+                    start, ctype = i, tag_type
+                elif is_inside and start is not None and tag_type == ctype:
+                    pass
+                else:
+                    if start is not None:
+                        chunks.append((pos + start, pos + i - 1, ctype))
+                    start, ctype = None, None
+            if start is not None:
+                chunks.append((pos + start, pos + L - 1, ctype))
+            pos += L
+        return set(c for c in chunks if c[2] not in excluded)
+
+    if isinstance(inference, SeqTensor):
+        inf_data, lens = np.asarray(inference.data), np.asarray(inference.lengths)
+    else:
+        inf_data = np.asarray(inference)
+        lens = [inf_data.shape[0]]
+    lab_data = np.asarray(label.data if isinstance(label, SeqTensor) else label)
+    inf_chunks = get_chunks(inf_data, lens)
+    lab_chunks = get_chunks(lab_data, lens)
+    correct = len(inf_chunks & lab_chunks)
+    p = correct / max(len(inf_chunks), 1)
+    r = correct / max(len(lab_chunks), 1)
+    f1 = 2 * p * r / max(p + r, 1e-12)
+    return out(
+        Precision=jnp.asarray(p, jnp.float32),
+        Recall=jnp.asarray(r, jnp.float32),
+        F1_Score=jnp.asarray(f1, jnp.float32),
+        NumInferChunks=jnp.asarray(len(inf_chunks), jnp.int64),
+        NumLabelChunks=jnp.asarray(len(lab_chunks), jnp.int64),
+        NumCorrectChunks=jnp.asarray(correct, jnp.int64),
+    )

@@ -1,0 +1,202 @@
+"""RNN ops: fused LSTM/GRU cells + whole-sequence recurrences via lax.scan.
+
+Reference parity: operators/lstm_op.cc (dynamic_lstm), gru_op.cc
+(dynamic_gru), lstm_unit_op.cc, gru_unit_op.cc, operators/math/lstm_compute
++ sequence2batch.h. The reference reorders ragged batches into time-major
+"batch" layout and runs a per-timestep fused kernel; here the SeqTensor is
+padded to [B,T,*] (sequence2batch equivalent) and the recurrence is a single
+lax.scan whose body XLA fuses — per-step h@W matmuls ride the MXU.
+
+Gate layout convention (this framework's spec, used consistently by
+layers.dynamic_lstm/gru and tests): LSTM gates [i, f, c~, o] concatenated on
+the last dim; GRU gates [u, r] + candidate c.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, SeqTensor
+from .util import first, out
+from .sequence_ops import seq_to_padded, padded_to_seq
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _mm(a, b):
+    pref = jnp.float32 if a.dtype in (jnp.bfloat16, jnp.float16) else None
+    return jnp.matmul(a, b, preferred_element_type=pref).astype(a.dtype)
+
+
+@register_op("lstm", lod_aware=True)
+def lstm_op(ctx, ins, attrs):
+    """dynamic_lstm: Input [N,4D] ragged (already x@W_x+b projected),
+    Weight [D,4D] recurrent, Bias [1,4D] (+[1,3D] peephole tail when
+    use_peepholes). Outputs Hidden/Cell ragged [N,D]."""
+    x = first(ins, "Input")
+    w = first(ins, "Weight")
+    bias = first(ins, "Bias")
+    h0 = first(ins, "H0")
+    c0 = first(ins, "C0")
+    use_peepholes = attrs.get("use_peepholes", False)
+    is_reverse = attrs.get("is_reverse", False)
+    gact = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cact = _ACT[attrs.get("cell_activation", "tanh")]
+    hact = _ACT[attrs.get("candidate_activation", "tanh")]
+    d = w.shape[0]
+
+    is_seq = isinstance(x, SeqTensor)
+    if is_seq:
+        T = attrs.get("max_len", -1)
+        if T is None or T < 0:
+            T = int(x.ntokens)
+        xp = seq_to_padded(x, T)  # [B,T,4D]
+        lengths = x.lengths
+    else:
+        xp = x  # dense [B,T,4D]
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    B, T = xp.shape[0], xp.shape[1]
+
+    gate_b = bias[:, : 4 * d] if bias is not None else 0.0
+    if use_peepholes and bias is not None:
+        w_ic = bias[:, 4 * d : 5 * d]
+        w_fc = bias[:, 5 * d : 6 * d]
+        w_oc = bias[:, 6 * d : 7 * d]
+    h_init = h0 if h0 is not None else jnp.zeros((B, d), xp.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((B, d), xp.dtype)
+
+    xs = jnp.swapaxes(xp, 0, 1)  # [T,B,4D]
+    ts = jnp.arange(T)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        x_t, t = inp
+        gates = x_t + _mm(h_prev, w) + gate_b
+        i_g, f_g, c_g, o_g = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            i_g = i_g + w_ic * c_prev
+            f_g = f_g + w_fc * c_prev
+        i = gact(i_g)
+        f = gact(f_g)
+        c_new = f * c_prev + i * cact(c_g)
+        if use_peepholes:
+            o_g = o_g + w_oc * c_new
+        o = gact(o_g)
+        h_new = o * hact(c_new)
+        mask = (t < lengths)[:, None].astype(xp.dtype)
+        h_new = mask * h_new + (1 - mask) * h_prev
+        c_new = mask * c_new + (1 - mask) * c_prev
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = lax.scan(step, (h_init, c_init), (xs, ts), reverse=is_reverse)
+    hidden = jnp.swapaxes(hs, 0, 1)  # [B,T,D]
+    cell = jnp.swapaxes(cs, 0, 1)
+    if is_seq:
+        return out(
+            Hidden=padded_to_seq(hidden, lengths, x.ntokens),
+            Cell=padded_to_seq(cell, lengths, x.ntokens),
+        )
+    return out(Hidden=hidden, Cell=cell)
+
+
+@register_op("gru", lod_aware=True)
+def gru_op(ctx, ins, attrs):
+    """dynamic_gru: Input [N,3D] ragged (x projected), Weight [D,3D]
+    ([:, :2D] update+reset recurrent, [:, 2D:] candidate recurrent),
+    Bias [1,3D]. h_t = u*h_prev + (1-u)*c (reference gru_op.cc)."""
+    x = first(ins, "Input")
+    w = first(ins, "Weight")
+    bias = first(ins, "Bias")
+    h0 = first(ins, "H0")
+    is_reverse = attrs.get("is_reverse", False)
+    gact = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cact = _ACT[attrs.get("activation", "tanh")]
+    d = w.shape[0]
+
+    is_seq = isinstance(x, SeqTensor)
+    if is_seq:
+        T = attrs.get("max_len", -1)
+        if T is None or T < 0:
+            T = int(x.ntokens)
+        xp = seq_to_padded(x, T)
+        lengths = x.lengths
+    else:
+        xp = x
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    B, T = xp.shape[0], xp.shape[1]
+    if bias is not None:
+        xp = xp + bias
+    w_ur = w[:, : 2 * d]
+    w_c = w[:, 2 * d :]
+    h_init = h0 if h0 is not None else jnp.zeros((B, d), xp.dtype)
+    xs = jnp.swapaxes(xp, 0, 1)
+    ts = jnp.arange(T)
+
+    def step(h_prev, inp):
+        x_t, t = inp
+        x_ur, x_c = x_t[:, : 2 * d], x_t[:, 2 * d :]
+        ur = gact(x_ur + _mm(h_prev, w_ur))
+        u, r = jnp.split(ur, 2, axis=-1)
+        c = cact(x_c + _mm(r * h_prev, w_c))
+        h_new = u * h_prev + (1 - u) * c
+        mask = (t < lengths)[:, None].astype(xp.dtype)
+        h_new = mask * h_new + (1 - mask) * h_prev
+        return h_new, h_new
+
+    _, hs = lax.scan(step, h_init, (xs, ts), reverse=is_reverse)
+    hidden = jnp.swapaxes(hs, 0, 1)
+    if is_seq:
+        return out(Hidden=padded_to_seq(hidden, lengths, x.ntokens))
+    return out(Hidden=hidden)
+
+
+@register_op("lstm_unit")
+def lstm_unit_op(ctx, ins, attrs):
+    """reference lstm_unit_op.cc: X=[B,4D] pre-projected gates, C_prev."""
+    x, c_prev = first(ins, "X"), first(ins, "C_prev")
+    fb = attrs.get("forget_bias", 0.0)
+    i_g, f_g, c_g, o_g = jnp.split(x, 4, axis=-1)
+    i = jax.nn.sigmoid(i_g)
+    f = jax.nn.sigmoid(f_g + fb)
+    c = f * c_prev + i * jnp.tanh(c_g)
+    h = jax.nn.sigmoid(o_g) * jnp.tanh(c)
+    return out(C=c, H=h)
+
+
+@register_op("gru_unit")
+def gru_unit_op(ctx, ins, attrs):
+    """reference gru_unit_op.cc: one GRU step.
+    Input=[B,3D] (x projection), HiddenPrev=[B,D], Weight=[D,3D]."""
+    x, h_prev = first(ins, "Input"), first(ins, "HiddenPrev")
+    w, bias = first(ins, "Weight"), first(ins, "Bias")
+    d = h_prev.shape[-1]
+    gact = _ACT.get(
+        {0: "identity", 1: "sigmoid", 2: "tanh", 3: "relu"}.get(
+            attrs.get("gate_activation", 1), "sigmoid"
+        )
+        if isinstance(attrs.get("gate_activation", 1), int)
+        else attrs.get("gate_activation", "sigmoid")
+    )
+    cact = _ACT.get(
+        {0: "identity", 1: "sigmoid", 2: "tanh", 3: "relu"}.get(
+            attrs.get("activation", 2), "tanh"
+        )
+        if isinstance(attrs.get("activation", 2), int)
+        else attrs.get("activation", "tanh")
+    )
+    g = x
+    if bias is not None:
+        g = g + bias
+    x_ur, x_c = g[:, : 2 * d], g[:, 2 * d :]
+    ur = gact(x_ur + _mm(h_prev, w[:, : 2 * d]))
+    u, r = jnp.split(ur, 2, axis=-1)
+    reset_h = r * h_prev
+    c = cact(x_c + _mm(reset_h, w[:, 2 * d :]))
+    h = u * h_prev + (1 - u) * c
+    gate = jnp.concatenate([u, r, c], axis=-1)
+    return out(Gate=gate, ResetHiddenPrev=reset_h, Hidden=h)

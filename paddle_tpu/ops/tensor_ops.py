@@ -1,0 +1,281 @@
+"""Tensor manipulation + initializer + embedding ops.
+
+Reference parity: operators/{cast,concat,split,reshape,transpose,pad,crop,
+gather,scatter,one_hot,fill_constant,fill_zeros_like,gaussian_random,
+uniform_random,assign,shape,increment,lookup_table,expand,multiplex,
+label_smooth,lod_reset,cum,arg_min_max}_op.cc.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op, register_grad_maker, SeqTensor
+from ..core import dtypes
+from .util import first, many, out, astype
+
+
+@register_op("cast")
+def cast_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    return out(Out=astype(x, attrs["out_dtype"]))
+
+
+@register_op("concat")
+def concat_op(ctx, ins, attrs):
+    xs = many(ins, "X")
+    return out(Out=jnp.concatenate(xs, axis=attrs.get("axis", 0)))
+
+
+@register_op("split")
+def split_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis", -1)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if num:
+        parts = jnp.split(x, num, axis=axis)
+    else:
+        idx = np.cumsum(sections)[:-1]
+        parts = jnp.split(x, idx.tolist(), axis=axis)
+    return out(Out=list(parts))
+
+
+@register_op("reshape")
+def reshape_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    shape = list(attrs["shape"])
+    # reference reshape_op.cc: 0 means copy dim from input
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return out(Out=x.reshape(shape))
+
+
+@register_op("transpose")
+def transpose_op(ctx, ins, attrs):
+    return out(Out=jnp.transpose(first(ins, "X"), attrs["axis"]))
+
+
+@register_op("pad")
+def pad_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    p = attrs["paddings"]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return out(Out=jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0)))
+
+
+@register_op("crop")
+def crop_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    offsets = attrs.get("offsets")
+    shape = attrs.get("shape")
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return out(Out=x[slices])
+
+
+@register_op("gather")
+def gather_op(ctx, ins, attrs):
+    x, idx = first(ins, "X"), first(ins, "Index")
+    return out(Out=jnp.take(x, idx.astype(jnp.int32), axis=0))
+
+
+@register_op("scatter")
+def scatter_op(ctx, ins, attrs):
+    x, idx, upd = first(ins, "X"), first(ins, "Ids"), first(ins, "Updates")
+    return out(Out=x.at[idx.astype(jnp.int32)].set(upd))
+
+
+@register_op("one_hot")
+def one_hot_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    depth = attrs["depth"]
+    flat = x.reshape(-1).astype(jnp.int32)
+    return out(Out=jax.nn.one_hot(flat, depth, dtype=jnp.float32))
+
+
+@register_op("fill_constant")
+def fill_constant_op(ctx, ins, attrs):
+    dtype = dtypes.to_jnp(attrs.get("dtype", "float32"))
+    return out(Out=jnp.full(tuple(attrs["shape"]), attrs["value"], dtype=dtype))
+
+
+@register_op("fill_constant_batch_size_like")
+def fill_constant_batch_size_like_op(ctx, ins, attrs):
+    ref = first(ins, "Input")
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = dtypes.to_jnp(attrs.get("dtype", "float32"))
+    return out(Out=jnp.full(tuple(shape), attrs["value"], dtype=dtype))
+
+
+@register_op("fill_zeros_like")
+def fill_zeros_like_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    if isinstance(x, SeqTensor):
+        return out(Out=SeqTensor(jnp.zeros_like(x.data), x.lengths))
+    return out(Out=jnp.zeros_like(x))
+
+
+@register_op("gaussian_random")
+def gaussian_random_op(ctx, ins, attrs):
+    seed = attrs.get("seed", 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
+    dtype = dtypes.to_jnp(attrs.get("dtype", "float32"))
+    o = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.normal(
+        key, tuple(attrs["shape"]), dtype=jnp.float32
+    )
+    return out(Out=o.astype(dtype))
+
+
+@register_op("truncated_gaussian_random")
+def truncated_gaussian_random_op(ctx, ins, attrs):
+    seed = attrs.get("seed", 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
+    dtype = dtypes.to_jnp(attrs.get("dtype", "float32"))
+    o = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.truncated_normal(
+        key, -2.0, 2.0, tuple(attrs["shape"]), dtype=jnp.float32
+    )
+    return out(Out=o.astype(dtype))
+
+
+@register_op("uniform_random")
+def uniform_random_op(ctx, ins, attrs):
+    seed = attrs.get("seed", 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
+    dtype = dtypes.to_jnp(attrs.get("dtype", "float32"))
+    o = jax.random.uniform(
+        key,
+        tuple(attrs["shape"]),
+        minval=attrs.get("min", -1.0),
+        maxval=attrs.get("max", 1.0),
+        dtype=jnp.float32,
+    )
+    return out(Out=o.astype(dtype))
+
+
+@register_op("assign", lod_aware=True)
+def assign_op(ctx, ins, attrs):
+    return out(Out=first(ins, "X"))
+
+
+@register_op("shape")
+def shape_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    return out(Out=jnp.asarray(x.shape, dtype=jnp.int64))
+
+
+@register_op("increment")
+def increment_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    return out(Out=x + jnp.asarray(attrs.get("step", 1.0), dtype=x.dtype))
+
+
+@register_op("lookup_table", lod_aware=True)
+def lookup_table_op(ctx, ins, attrs):
+    """reference operators/lookup_table_op.cc (embedding).
+
+    Ids may be a SeqTensor (ragged token ids [N,1]); output inherits lod.
+    Sparse-grad (SelectedRows) is represented densely — XLA turns the
+    one-hot-matmul/gather vjp into an efficient scatter on TPU.
+    """
+    w = first(ins, "W")
+    ids = first(ins, "Ids")
+    lengths = ids.lengths if isinstance(ids, SeqTensor) else None
+    idx = (ids.data if lengths is not None else ids)
+    idx = idx.reshape(idx.shape[:-1]) if idx.shape[-1] == 1 else idx
+    idx = idx.astype(jnp.int32)
+    o = jnp.take(w, idx, axis=0)
+    padding_idx = attrs.get("padding_idx", None)
+    if padding_idx is not None and padding_idx >= 0:
+        o = jnp.where((idx == padding_idx)[..., None], 0.0, o)
+    if lengths is not None:
+        return out(Out=SeqTensor(o, lengths))
+    return out(Out=o)
+
+
+@register_op("expand")
+def expand_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    times = attrs["expand_times"]
+    return out(Out=jnp.tile(x, times))
+
+
+@register_op("multiplex")
+def multiplex_op(ctx, ins, attrs):
+    idx = first(ins, "Ids").reshape(-1).astype(jnp.int32)
+    xs = jnp.stack(many(ins, "X"), axis=0)  # [K, B, ...]
+    rows = jnp.arange(idx.shape[0])
+    return out(Out=xs[idx, rows])
+
+
+@register_op("label_smooth")
+def label_smooth_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    eps = attrs.get("epsilon", 0.0)
+    dist = first(ins, "PriorDist")
+    k = x.shape[-1]
+    if dist is not None:
+        o = (1 - eps) * x + eps * dist
+    else:
+        o = (1 - eps) * x + eps / k
+    return out(Out=o)
+
+
+@register_op("lod_reset", lod_aware=True)
+def lod_reset_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    data = x.data if isinstance(x, SeqTensor) else x
+    if y is not None:
+        lengths = y.lengths if isinstance(y, SeqTensor) else y
+        return out(Out=SeqTensor(data, lengths))
+    target_lod = attrs.get("target_lod")
+    lengths = jnp.asarray(np.diff(np.asarray(target_lod)), dtype=jnp.int32)
+    return out(Out=SeqTensor(data, lengths))
+
+
+@register_op("reverse")
+def reverse_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs["axis"]
+    if isinstance(axis, int):
+        axis = [axis]
+    return out(Out=jnp.flip(x, axis=tuple(axis)))
+
+
+@register_op("assign_value")
+def assign_value_op(ctx, ins, attrs):
+    vals = attrs["values"]
+    arr = np.asarray(vals).reshape(attrs["shape"])
+    return out(Out=jnp.asarray(arr, dtype=dtypes.to_jnp(attrs.get("dtype", "float32"))))
+
+
+@register_op("arg_max")
+def arg_max_op(ctx, ins, attrs):
+    return out(Out=jnp.argmax(first(ins, "X"), axis=attrs.get("axis", -1)).astype(jnp.int64))
+
+
+@register_op("arg_min")
+def arg_min_op(ctx, ins, attrs):
+    return out(Out=jnp.argmin(first(ins, "X"), axis=attrs.get("axis", -1)).astype(jnp.int64))
+
+
+@register_op("argsort")
+def argsort_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return out(Out=jnp.take_along_axis(x, idx, axis=axis), Indices=idx.astype(jnp.int64))
+
+
+@register_op("is_empty")
+def is_empty_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    return out(Out=jnp.asarray(x.size == 0))
+
+
+@register_op("isfinite")
+def isfinite_op(ctx, ins, attrs):
+    x = first(ins, "X")
+    return out(Out=jnp.all(jnp.isfinite(x)))

@@ -1,0 +1,49 @@
+"""Kernel-authoring helpers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op, SeqTensor, seq_data
+from ..core import dtypes
+
+
+def first(ins, slot, default=None):
+    vals = ins.get(slot)
+    if not vals:
+        return default
+    return vals[0]
+
+
+def many(ins, slot):
+    return [v for v in ins.get(slot, []) if v is not None]
+
+
+def out(**slots):
+    return {k: v if isinstance(v, list) else [v] for k, v in slots.items()}
+
+
+def unary_op(name, fn):
+    """Register a simple elementwise unary op X -> Out."""
+
+    @register_op(name)
+    def _kernel(ctx, ins, attrs, _fn=fn):
+        return out(Out=_fn(first(ins, "X"), attrs))
+
+    return _kernel
+
+
+def astype(x, dtype):
+    return x.astype(dtypes.to_jnp(dtype))
+
+
+def bcast_y_to_x(x, y, axis):
+    """Reference elementwise broadcast: Y's shape matches a contiguous
+    subsequence of X's dims starting at `axis` (default: trailing align).
+    operators/elementwise_op_function.h semantics."""
+    if x.ndim == y.ndim:
+        return y
+    if axis == -1 or axis is None:
+        axis = x.ndim - y.ndim
+    new_shape = [1] * axis + list(y.shape) + [1] * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)

@@ -1,0 +1,118 @@
+"""Profiler (reference python/paddle/fluid/profiler.py + platform/profiler.cc).
+
+TPU-native: wraps jax.profiler (XPlane/Perfetto traces of XLA executions —
+the CUPTI device-tracer equivalent) plus a host-side event table mirroring
+the reference's RecordEvent aggregation (profiler.cc:326 ParseEvents) so
+`profiler(...)` prints the familiar per-op summary for eager runs.
+"""
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
+           "stop_profiler", "record_event"]
+
+_host_events = []  # (name, start, end)
+_enabled = False
+_trace_dir = None
+
+
+class _Event:
+    __slots__ = ("name", "start", "end")
+
+    def __init__(self, name):
+        self.name = name
+        self.start = time.perf_counter()
+        self.end = None
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """RAII host event (reference platform/profiler.h:72 RecordEvent)."""
+    ev = _Event(name)
+    try:
+        yield
+    finally:
+        ev.end = time.perf_counter()
+        if _enabled:
+            _host_events.append(ev)
+
+
+def reset_profiler():
+    del _host_events[:]
+
+
+def start_profiler(state="All", trace_dir=None):
+    global _enabled, _trace_dir
+    _enabled = True
+    if trace_dir:
+        _trace_dir = trace_dir
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    global _enabled, _trace_dir
+    _enabled = False
+    if _trace_dir:
+        jax.profiler.stop_trace()
+        _trace_dir = None
+    _print_summary(sorted_key, profile_path)
+
+
+def _print_summary(sorted_key, profile_path):
+    if not _host_events:
+        return
+    stats = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])  # calls, total, min, max
+    for ev in _host_events:
+        s = stats[ev.name]
+        dur = (ev.end - ev.start) * 1000.0
+        s[0] += 1
+        s[1] += dur
+        s[2] = min(s[2], dur)
+        s[3] = max(s[3], dur)
+    items = list(stats.items())
+    key_fn = {
+        "calls": lambda kv: -kv[1][0],
+        "total": lambda kv: -kv[1][1],
+        "max": lambda kv: -kv[1][3],
+        "min": lambda kv: -kv[1][2],
+        "ave": lambda kv: -(kv[1][1] / kv[1][0]),
+    }.get(sorted_key, lambda kv: -kv[1][1])
+    items.sort(key=key_fn)
+    header = f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Min(ms)':>10}{'Max(ms)':>10}{'Ave(ms)':>10}"
+    lines = [header, "-" * len(header)]
+    for name, (calls, total, mn, mx) in items:
+        lines.append(
+            f"{name:<40}{calls:>8}{total:>12.4f}{mn:>10.4f}{mx:>10.4f}{total / calls:>10.4f}"
+        )
+    report = "\n".join(lines)
+    print(report)
+    try:
+        with open(profile_path + ".txt", "w") as f:
+            f.write(report)
+    except OSError:
+        pass
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """API parity with reference profiler.py:33; maps to a jax trace."""
+    jax.profiler.start_trace(output_file if "/" in str(output_file) else "/tmp/jax_trace")
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def profiler(state, sorted_key=None, profile_path="/tmp/profile"):
+    """reference profiler.py:76. state in {'CPU','GPU','All'} — on TPU all
+    states enable the jax trace + host events."""
+    start_profiler(state, trace_dir="/tmp/jax_trace")
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,445 @@
+"""DistributeTranspiler: rewrite a single-process program into trainer +
+parameter-server programs.
+
+Reference parity: python/paddle/fluid/transpiler/distribute_transpiler.py:169
+  - split_dense_variable(:98): params/grads chopped into ~min_block_size
+    element blocks for shard balance
+  - trainer rewrite: split_byref + send_vars + send_barrier + recv +
+    fetch_barrier + concat (:288-380)
+  - get_pserver_program(:413): per-param-block optimize sub-blocks under a
+    listen_and_serv op
+  - get_startup_program(:569)
+
+The transport behind send/recv/listen_and_serv ops is this build's TCP
+runtime (paddle_tpu/parallel/rpc.py) — the gRPC-runtime equivalent. On TPU
+the recommended distributed mode is collective DP over the mesh
+(see parallel/distributed.py); the pserver path keeps capability parity for
+CPU-side sparse/async workloads.
+"""
+
+import math
+
+from ..core.framework import (
+    Program,
+    Parameter,
+    default_main_program,
+    default_startup_program,
+    OpRole,
+    OP_ROLE_ATTR_NAME,
+    OP_ROLE_VAR_ATTR_NAME,
+)
+from .ps_dispatcher import RoundRobin
+
+LOOKUP_TABLE_TYPE = "lookup_table"
+RPC_OP_ROLE_ATTR_VALUE = OpRole.RPC
+
+
+class VarBlock:
+    def __init__(self, varname, offset, size):
+        self.varname = varname
+        self.offset = offset
+        self.size = size
+
+    def __str__(self):
+        return "%s:%d:%d" % (self.varname, self.offset, self.size)
+
+
+def same_or_split_var(p_name, var_name):
+    return p_name == var_name or p_name.startswith(var_name + ".block")
+
+
+def split_dense_variable(var_list, service_count, min_block_size=8192):
+    """reference distribute_transpiler.py:98 — chop each var into blocks of
+    >= min_block_size elements, at most `service_count` blocks per var."""
+    blocks = []
+    for var in var_list:
+        split_count = service_count
+        var_numel = int(math.prod(var.shape)) if var.shape else 1
+        max_pserver_count = int(math.floor(var_numel / float(min_block_size)))
+        if max_pserver_count == 0:
+            max_pserver_count = 1
+        if max_pserver_count < service_count:
+            split_count = max_pserver_count
+        block_size = int(math.ceil(var_numel / float(split_count)))
+
+        if len(var.shape) >= 2:
+            dim1 = int(math.prod(var.shape[1:]))
+            remains = block_size % dim1
+            if remains != 0:
+                block_size += dim1 - remains
+        split_count = int(math.ceil(var_numel / float(block_size)))
+        for block_id in range(split_count):
+            curr_block_size = min(block_size, var_numel - (block_id * block_size))
+            block = VarBlock(var.name, block_id, curr_block_size)
+            blocks.append(str(block))
+    return blocks
+
+
+class DistributeTranspiler:
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, split_method=RoundRobin, sync_mode=True,
+                  startup_program=None):
+        assert callable(split_method) or isinstance(split_method, type)
+        if program is None:
+            program = default_main_program()
+        if startup_program is None:
+            startup_program = default_startup_program()
+        self.origin_program = program
+        self.startup_program = startup_program
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self.trainer_id = trainer_id
+        pserver_endpoints = pservers.split(",")
+        self.pserver_endpoints = pserver_endpoints
+        self.optimize_ops, self.params_grads = self._get_optimize_pass()
+        ps_dispatcher = split_method(self.pserver_endpoints)
+
+        # split params/grads into blocks
+        param_list = [pg[0] for pg in self.params_grads]
+        grad_list = [pg[1] for pg in self.params_grads]
+        grad_blocks = split_dense_variable(grad_list, len(pserver_endpoints))
+        param_blocks = split_dense_variable(param_list, len(pserver_endpoints))
+        self.param_grad_ep_mapping = {
+            ep: {"params": [], "grads": []} for ep in pserver_endpoints
+        }
+
+        # create split vars on the trainer side
+        self.param_var_mapping = self._create_vars_from_blocklist(program, param_blocks)
+        self.grad_var_mapping = self._create_vars_from_blocklist(
+            program, grad_blocks, add_trainer_suffix=self.trainer_num > 1
+        )
+        self.grad_param_mapping = {}
+        for g, p in zip(grad_blocks, param_blocks):
+            g_name, g_bid, _ = g.split(":")
+            p_name, p_bid, _ = p.split(":")
+            self.grad_param_mapping[
+                self.grad_var_mapping[g_name][int(g_bid)]
+            ] = self.param_var_mapping[p_name][int(p_bid)]
+
+        # dispatch grads to endpoints
+        grad_var_mapping_items = sorted(self.grad_var_mapping.items())
+        send_vars = []
+        self.grad_name_to_send_dummy_out = {}
+        eplist_all = []
+        for orig_varname, splited_vars in grad_var_mapping_items:
+            eplist = ps_dispatcher.dispatch(splited_vars)
+            eplist_all.extend(eplist)
+            for i, var in enumerate(splited_vars):
+                send_vars.append(var)
+                self.param_grad_ep_mapping[eplist[i]]["grads"].append(var)
+
+        block = program.global_block()
+        # insert split ops after the op producing each grad
+        for orig_varname, splited_vars in grad_var_mapping_items:
+            if len(splited_vars) <= 1:
+                continue
+            orig_var = block.var(orig_varname)
+            index = self._find_op_index_by_output(block, orig_varname)
+            sections = [int(math.prod(v.shape)) // (int(math.prod(v.shape[1:])) or 1)
+                        if len(v.shape) >= 2 else int(math.prod(v.shape))
+                        for v in splited_vars]
+            block.insert_op(
+                index + 1,
+                "split_byref",
+                {"X": [orig_var]},
+                {"Out": splited_vars},
+                {"sections": sections, "axis": 0,
+                 OP_ROLE_ATTR_NAME: OpRole.Backward},
+            )
+
+        # send ops
+        dummy_output = block.create_var(name="RPC_OP_ROLE_DUMMY")
+        block.append_op(
+            "send_vars",
+            {"X": send_vars},
+            {"Out": [dummy_output]},
+            {
+                "epmap": eplist_all,
+                "sync_send": self.sync_mode,
+                OP_ROLE_ATTR_NAME: RPC_OP_ROLE_ATTR_VALUE,
+                OP_ROLE_VAR_ATTR_NAME: [v.name for v in send_vars],
+            },
+        )
+        if self.sync_mode:
+            block.append_op(
+                "send_barrier",
+                {},
+                {"Out": []},
+                {
+                    "endpoints": pserver_endpoints,
+                    "sync_mode": self.sync_mode,
+                    OP_ROLE_ATTR_NAME: RPC_OP_ROLE_ATTR_VALUE,
+                },
+            )
+
+        # recv each param shard back
+        recv_vars = []
+        for ep in pserver_endpoints:
+            for g in self.param_grad_ep_mapping[ep]["grads"]:
+                p = self.grad_param_mapping[g]
+                self.param_grad_ep_mapping[ep]["params"].append(p)
+        for orig_varname, splited_vars in sorted(self.param_var_mapping.items()):
+            eps = []
+            for var in splited_vars:
+                for ep in pserver_endpoints:
+                    if var in self.param_grad_ep_mapping[ep]["params"]:
+                        eps.append(ep)
+                        break
+            block.append_op(
+                "recv",
+                {"X": []},
+                {"Out": splited_vars},
+                {"epmap": eps, OP_ROLE_ATTR_NAME: RPC_OP_ROLE_ATTR_VALUE},
+            )
+        block.append_op(
+            "fetch_barrier",
+            {},
+            {"Out": []},
+            {
+                "endpoints": pserver_endpoints,
+                OP_ROLE_ATTR_NAME: RPC_OP_ROLE_ATTR_VALUE,
+            },
+        )
+        for orig_varname, splited_vars in sorted(self.param_var_mapping.items()):
+            if len(splited_vars) <= 1:
+                continue
+            orig_var = block.var(orig_varname)
+            block.append_op(
+                "concat",
+                {"X": splited_vars},
+                {"Out": [orig_var]},
+                {"axis": 0},
+            )
+
+        self._delete_trainer_optimize_ops(block)
+
+    def _delete_trainer_optimize_ops(self, block):
+        block.ops = [
+            op
+            for op in block.ops
+            if op.attrs.get(OP_ROLE_ATTR_NAME) != OpRole.Optimize
+        ]
+        block.program._mutation += 1
+
+    def get_trainer_program(self):
+        """reference :406."""
+        return self.origin_program
+
+    def get_pserver_program(self, endpoint):
+        """reference :413 — build the pserver-side program: per-param-shard
+        optimize sub-blocks under listen_and_serv."""
+        pserver_program = Program()
+        recv_inputs = []
+        for v in self.param_grad_ep_mapping[endpoint]["params"]:
+            self._clone_var(pserver_program.global_block(), v)
+        for v in self.param_grad_ep_mapping[endpoint]["grads"]:
+            # trainer-suffixed grad receive buffers
+            for trainer_id in range(self.trainer_num):
+                if self.trainer_num > 1:
+                    name = f"{v.name}.trainer_{trainer_id}"
+                else:
+                    name = v.name
+                var = pserver_program.global_block().create_var(
+                    name=name, persistable=False, dtype=v.dtype, shape=v.shape
+                )
+                recv_inputs.append(var)
+
+        optimize_block_ids = []
+        for idx, (param, grad) in enumerate(
+            self._endpoint_param_grads(endpoint)
+        ):
+            per_opt_block = pserver_program.create_block(0)
+            pserver_program.rollback()
+            for op in self.optimize_ops:
+                if (
+                    "Param" in op.inputs
+                    and same_or_split_var(param.name, op.input("Param")[0])
+                ):
+                    self._append_pserver_optimize_op(
+                        pserver_program, per_opt_block, op, param, grad, endpoint
+                    )
+            optimize_block_ids.append(per_opt_block)
+
+        pserver_program.global_block().append_op(
+            "listen_and_serv",
+            {"X": recv_inputs},
+            {},
+            {
+                "OptimizeBlocks": optimize_block_ids,
+                "endpoint": endpoint,
+                "Fanin": self.trainer_num,
+                "sync_mode": self.sync_mode,
+                "grad_to_block_id": [
+                    f"{g.name}:{b.idx}"
+                    for g, b in zip(
+                        self.param_grad_ep_mapping[endpoint]["grads"],
+                        optimize_block_ids,
+                    )
+                ],
+            },
+        )
+        return pserver_program
+
+    def _endpoint_param_grads(self, endpoint):
+        return list(
+            zip(
+                self.param_grad_ep_mapping[endpoint]["params"],
+                self.param_grad_ep_mapping[endpoint]["grads"],
+            )
+        )
+
+    def _append_pserver_optimize_op(self, program, block, op, param, grad, endpoint):
+        """clone one optimizer op onto the pserver block, rewired to the
+        shard vars (reference __append_optimize_op__:494)."""
+        new_inputs = {}
+        for key, names in op.inputs.items():
+            if key == "Param":
+                new_inputs[key] = [param.name]
+            elif key == "Grad":
+                if self.sync_mode and self.trainer_num > 1:
+                    # aggregate trainer grads: sum op first
+                    merged = block.create_var(
+                        name=grad.name + ".merged", dtype=grad.dtype, shape=grad.shape
+                    )
+                    block.append_op(
+                        "sum",
+                        {
+                            "X": [
+                                f"{grad.name}.trainer_{tid}"
+                                for tid in range(self.trainer_num)
+                            ]
+                        },
+                        {"Out": [merged]},
+                    )
+                    scaled = block.create_var(
+                        name=grad.name + ".scaled", dtype=grad.dtype, shape=grad.shape
+                    )
+                    block.append_op(
+                        "scale",
+                        {"X": [merged]},
+                        {"Out": [scaled]},
+                        {"scale": 1.0 / self.trainer_num},
+                    )
+                    new_inputs[key] = [scaled.name]
+                else:
+                    new_inputs[key] = [grad.name]
+            else:
+                for n in names:
+                    orig_var = self.origin_program.global_block().vars.get(n)
+                    if orig_var is not None and not block.program.global_block().has_var(n):
+                        self._clone_var(block.program.global_block(), orig_var)
+                new_inputs[key] = list(names)
+        new_outputs = {}
+        for key, names in op.outputs.items():
+            rewired = []
+            for n in names:
+                if same_or_split_var(param.name, n):
+                    rewired.append(param.name)
+                else:
+                    if not block.program.global_block().has_var(n):
+                        orig_var = self.origin_program.global_block().vars.get(n)
+                        if orig_var is not None:
+                            self._clone_var(block.program.global_block(), orig_var)
+                    rewired.append(n)
+            new_outputs[key] = rewired
+        block.append_op(op.type, new_inputs, new_outputs, dict(op.attrs))
+
+    def get_startup_program(self, endpoint, pserver_program):
+        """reference :569 — startup program for one pserver: create + init
+        only the vars that live on this endpoint."""
+        s_prog = Program()
+        orig_s_prog = self.startup_program
+        params = self.param_grad_ep_mapping[endpoint]["params"]
+        param_names = {p.name for p in params}
+
+        def _is_on_endpoint(var_name):
+            return any(same_or_split_var(p, var_name) for p in param_names) or any(
+                same_or_split_var(var_name, p.split(".block")[0]) for p in param_names
+            )
+
+        created = set()
+        for op in orig_s_prog.global_block().ops:
+            out_names = op.output_arg_names()
+            if not out_names:
+                continue
+            target = out_names[0]
+            if any(same_or_split_var(p, target) or p == target for p in param_names) or any(
+                target == p.split(".block")[0] for p in param_names
+            ):
+                orig_var = orig_s_prog.global_block().vars.get(target)
+                if orig_var is not None and target not in created:
+                    self._clone_var(s_prog.global_block(), orig_var)
+                    created.add(target)
+                s_prog.global_block().append_op(
+                    op.type, dict(op.inputs), dict(op.outputs), dict(op.attrs)
+                )
+        # split whole-param init into shard inits when needed
+        for p in params:
+            if p.name not in created and "block" in p.name:
+                self._clone_var(s_prog.global_block(), p)
+                s_prog.global_block().append_op(
+                    "fill_constant",
+                    {},
+                    {"Out": [p.name]},
+                    {"shape": list(p.shape), "value": 0.0, "dtype": p.dtype},
+                )
+        return s_prog
+
+    # ------------------------------------------------------------------
+    def _get_optimize_pass(self):
+        block = self.origin_program.global_block()
+        opt_ops = []
+        params_grads = []
+        for op in block.ops:
+            if op.attrs.get(OP_ROLE_ATTR_NAME) == OpRole.Optimize:
+                opt_ops.append(op)
+                if "Param" in op.inputs and "Grad" in op.inputs:
+                    p_name = op.input("Param")[0]
+                    g_name = op.input("Grad")[0]
+                    params_grads.append(
+                        (block.vars[p_name], block.vars[g_name])
+                    )
+        return opt_ops, params_grads
+
+    def _create_vars_from_blocklist(self, program, block_list, add_trainer_suffix=False):
+        """reference create_vars_from_blocklist — materialize split vars."""
+        block_map = {}
+        var_mapping = {}
+        for block_str in block_list:
+            varname, offset, size = block_str.split(":")
+            block_map.setdefault(varname, []).append((int(offset), int(size)))
+        for varname, split in sorted(block_map.items()):
+            orig_var = program.global_block().var(varname)
+            if len(split) == 1:
+                var_mapping[varname] = [orig_var]
+                continue
+            var_mapping[varname] = []
+            orig_shape = orig_var.shape
+            orig_dim1_flatten = int(math.prod(orig_shape[1:])) if len(orig_shape) >= 2 else 1
+            for i, (offset, size) in enumerate(split):
+                rows = size // orig_dim1_flatten
+                splited_shape = [rows] + list(orig_shape[1:])
+                new_var_name = "%s.block%d" % (varname, i)
+                var = program.global_block().create_var(
+                    name=new_var_name,
+                    persistable=False,
+                    dtype=orig_var.dtype,
+                    shape=splited_shape,
+                )
+                var_mapping[varname].append(var)
+        return var_mapping
+
+    def _clone_var(self, block, var, persistable=True):
+        return block.create_var(
+            name=var.name,
+            shape=var.shape,
+            dtype=var.dtype,
+            lod_level=var.lod_level,
+            persistable=persistable,
+        )
+
+    def _find_op_index_by_output(self, block, varname):
+        for i, op in enumerate(block.ops):
+            if varname in op.output_arg_names():
+                return i
+        return len(block.ops) - 1
