@@ -107,6 +107,42 @@ def collect_state_names(program, scope):
     return state_in, sorted(written)
 
 
+def _block_read_names(op):
+    """All var names read anywhere inside an op's sub-blocks (control flow)."""
+    names = set()
+    for v in op.attrs.values():
+        if hasattr(v, "ops"):  # a Block attr
+            for sub in v.ops:
+                names.update(sub.input_arg_names())
+                names.update(_block_read_names(sub))
+    return names
+
+
+def dead_code_eliminate(ops, needed_names):
+    """Drop ops whose outputs feed neither fetches nor persistable state.
+
+    The reference relies on Program.prune (framework.py:1112) before
+    inference; on the XLA path DCE is the executor's job so a
+    clone(for_test=True) program can run with only its data inputs fed.
+    Side-effectful host ops are kept conservatively.
+    """
+    needed = set(needed_names)
+    live = []
+    for op in reversed(ops):
+        outs = set(op.output_arg_names())
+        # control-flow ops (any Block attr) write into env by kernel side
+        # effect with empty declared outputs — always keep them
+        has_sub_block = any(hasattr(v, "ops") for v in op.attrs.values())
+        keep = (bool(outs & needed) or has_sub_block
+                or op.type in ("print", "assert_op"))
+        if keep:
+            live.append(op)
+            needed |= set(op.input_arg_names())
+            needed |= _block_read_names(op)
+    live.reverse()
+    return live
+
+
 def build_step_fn(program, fetch_names, state_out_names, is_test=False):
     """Build the pure step function for a program's global block.
 
@@ -114,7 +150,9 @@ def build_step_fn(program, fetch_names, state_out_names, is_test=False):
     mut_state (vars the block writes) is donated by the jit wrapper so
     parameter/optimizer-state buffers are updated in place on device.
     """
-    ops = program.global_block().ops
+    ops = dead_code_eliminate(
+        program.global_block().ops, list(fetch_names) + list(state_out_names)
+    )
 
     def step(mut_state, const_state, feeds, rng):
         env = {}

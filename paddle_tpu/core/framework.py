@@ -473,11 +473,27 @@ class Program:
                 role = op.attrs.get(OP_ROLE_ATTR_NAME, OpRole.Forward)
                 if role not in (OpRole.Forward, OpRole.Forward | OpRole.Loss):
                     continue
-                if op.type in ("feed", "fetch") or (
+                has_sub_block = any(
+                    isinstance(v, Block) for v in op.attrs.values()
+                )
+                if op.type in ("feed", "fetch") or has_sub_block or (
                     set(op.output_arg_names()) & needed
                 ):
                     kept.append(op)
                     needed.update(op.input_arg_names())
+                    # vars read only inside control-flow sub-blocks are
+                    # live too (same rule as executor_core DCE)
+                    stack = [
+                        v for v in op.attrs.values() if isinstance(v, Block)
+                    ]
+                    while stack:
+                        blk = stack.pop()
+                        for sub in blk.ops:
+                            needed.update(sub.input_arg_names())
+                            stack.extend(
+                                v for v in sub.attrs.values()
+                                if isinstance(v, Block)
+                            )
             block.ops = list(reversed(kept))
             used = set()
             for op in block.ops:

@@ -234,7 +234,17 @@ def make_vjp_kernel(fwd_def):
                 return float0_like(o)
             if g is None:
                 return jnp.zeros_like(o)
-            return seq_data(g).astype(o.dtype)
+            gd = seq_data(g).astype(o.dtype)
+            # Tolerate scalar-vs-[1]-style mismatches (reference mean/loss
+            # vars are shape [1]; XLA scalars are rank-0): reshape only when
+            # the shapes differ by unit dims alone — a same-size but
+            # genuinely different layout must still raise in jax.vjp.
+            gs, os_ = jnp.shape(gd), jnp.shape(o)
+            if gs != os_ and tuple(d for d in gs if d != 1) == tuple(
+                d for d in os_ if d != 1
+            ):
+                gd = jnp.reshape(gd, os_)
+            return gd
 
         cotangents = {}
         for slot, outs in primal_outs.items():

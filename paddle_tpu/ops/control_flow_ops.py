@@ -144,9 +144,15 @@ class TensorArray:
         self.items = list(items or [])
 
     def write(self, i, value):
-        i = int(i) if not hasattr(i, "shape") else int(jax.device_get(i)) if not _is_traced(i) else None
-        if i is None:
-            raise NotImplementedError("traced-index tensor-array write inside jit region")
+        if _is_traced(i):
+            # A traced write index cannot be represented on the python-list
+            # array (and inside lax.while_loop bodies the list mutation
+            # would leak tracers) — loops over time steps must use the
+            # dedicated recurrent/dynamic_recurrent ops (lax.scan).
+            raise NotImplementedError(
+                "tensor-array write with a traced index: use StaticRNN/"
+                "DynamicRNN (recurrent ops) for in-loop array writes")
+        i = int(i) if not hasattr(i, "shape") else int(jax.device_get(i))
         while len(self.items) <= i:
             self.items.append(None)
         self.items[i] = value

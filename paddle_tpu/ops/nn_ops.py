@@ -13,6 +13,8 @@ preferred_element_type.
 
 import math
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -147,12 +149,12 @@ def pool2d_op(ctx, ins, attrs):
         )
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        o = lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max, window, strides_, pads)
+        o = lax.reduce_window(x, np.asarray(init, x.dtype), lax.max, window, strides_, pads)
     else:
-        s = lax.reduce_window(x, jnp.asarray(0.0, x.dtype), lax.add, window, strides_, pads)
+        s = lax.reduce_window(x, np.asarray(0.0, x.dtype), lax.add, window, strides_, pads)
         if attrs.get("exclusive", True):
             ones = jnp.ones_like(x)
-            cnt = lax.reduce_window(ones, jnp.asarray(0.0, x.dtype), lax.add, window, strides_, pads)
+            cnt = lax.reduce_window(ones, np.asarray(0.0, x.dtype), lax.add, window, strides_, pads)
             o = s / cnt
         else:
             o = s / (ksize[0] * ksize[1])

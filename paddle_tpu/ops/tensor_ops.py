@@ -81,7 +81,10 @@ def gather_op(ctx, ins, attrs):
 @register_op("scatter")
 def scatter_op(ctx, ins, attrs):
     x, idx, upd = first(ins, "X"), first(ins, "Ids"), first(ins, "Updates")
-    return out(Out=x.at[idx.astype(jnp.int32)].set(upd))
+    # jnp.asarray: X may be a concrete numpy constant (fill_constant), which
+    # has no .at[] accessor
+    x = jnp.asarray(x)
+    return out(Out=x.at[jnp.asarray(idx).astype(jnp.int32)].set(upd))
 
 
 @register_op("one_hot")
@@ -94,8 +97,11 @@ def one_hot_op(ctx, ins, attrs):
 
 @register_op("fill_constant")
 def fill_constant_op(ctx, ins, attrs):
-    dtype = dtypes.to_jnp(attrs.get("dtype", "float32"))
-    return out(Out=jnp.full(tuple(attrs["shape"]), attrs["value"], dtype=dtype))
+    # concrete numpy (NOT staged into the trace): constants must stay
+    # concrete so they can index tensor arrays / drive host-side decisions
+    # even inside a jit region (omnistaging makes jnp.full a tracer).
+    dtype = dtypes.to_np(attrs.get("dtype", "float32"))
+    return out(Out=np.full(tuple(attrs["shape"]), attrs["value"], dtype=dtype))
 
 
 @register_op("fill_constant_batch_size_like")

@@ -26,7 +26,7 @@ __all__ = [
     "Adadelta", "RMSProp", "Optimizer", "SGDOptimizer", "MomentumOptimizer",
     "AdagradOptimizer", "AdamOptimizer", "AdamaxOptimizer",
     "DecayedAdagradOptimizer", "AdadeltaOptimizer", "RMSPropOptimizer",
-    "ModelAverage",
+    "Ftrl", "FtrlOptimizer", "ModelAverage",
 ]
 
 
@@ -552,6 +552,46 @@ class ModelAverage(Optimizer):
         pass
 
 
+class FtrlOptimizer(Optimizer):
+    """FTRL-proximal (reference operators/ftrl_op.cc; optimizer surface
+    parity with the op library)."""
+
+    _squared_acc_str = "squared"
+    _linear_acc_str = "linear"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "ftrl"
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._squared_acc_str, p)
+            self._add_accumulator(self._linear_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        sq = self._get_accumulator(self._squared_acc_str, param_and_grad[0])
+        lin = self._get_accumulator(self._linear_acc_str, param_and_grad[0])
+        return block.append_op(
+            "ftrl",
+            {
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "SquaredAccumulator": [sq],
+                "LinearAccumulator": [lin],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            {
+                "ParamOut": [param_and_grad[0]],
+                "SquaredAccumOut": [sq],
+                "LinearAccumOut": [lin],
+            },
+            {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
 # aliases (reference exposes both short and long names)
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
@@ -561,3 +601,4 @@ Adamax = AdamaxOptimizer
 DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer

@@ -1,0 +1,198 @@
+"""Per-op numeric test harness.
+
+Reference parity: python/paddle/fluid/tests/unittests/op_test.py — each op
+test declares `op_type`, numpy `inputs`/`attrs`, and a numpy reference
+`outputs`; `check_output` runs the real kernel and compares within atol;
+`check_grad` compares analytic gradients (built through the IR-level grad
+makers, backward.py) against numeric finite-difference gradients
+(op_test.py:103 get_numeric_gradient).
+
+TPU adaptation: the "real kernel" is the XLA-compiled step produced by the
+Executor; there is no CPU-vs-GPU split — instead analytic-vs-numeric and
+kernel-vs-numpy are the correctness contracts. Tests run on the virtual
+8-device CPU platform (conftest.py).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program, program_guard
+from paddle_tpu.core.lod_tensor import LoDTensor
+from paddle_tpu import backward
+
+
+def _as_np(x):
+    if isinstance(x, LoDTensor):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+class OpTest:
+    """Subclass and implement setup() assigning:
+        self.op_type : str
+        self.inputs  : {slot: ndarray | (ndarray, lod) | [(name, ndarray), ...]}
+        self.outputs : {slot: ndarray | (ndarray, lod) | [(name, ndarray), ...]}
+        self.attrs   : dict (optional)
+    """
+
+    atol = 1e-5
+    rtol = 1e-4
+
+    # ------------------------------------------------------------------
+    def _entries(self, slot_value):
+        """Normalize a slot spec to [(var_name, ndarray, lod)]."""
+        if isinstance(slot_value, list):
+            out = []
+            for name, v in slot_value:
+                if isinstance(v, tuple):
+                    out.append((name, np.asarray(v[0]), v[1]))
+                else:
+                    out.append((name, np.asarray(v), None))
+            return out
+        if isinstance(slot_value, tuple):
+            return [(None, np.asarray(slot_value[0]), slot_value[1])]
+        return [(None, np.asarray(slot_value), None)]
+
+    def _build(self):
+        self.attrs = getattr(self, "attrs", {})
+        prog = Program()
+        feed = {}
+        in_map, out_map = {}, {}
+        with program_guard(prog):
+            block = prog.global_block()
+            for slot, spec in self.inputs.items():
+                names = []
+                for i, (name, arr, lod) in enumerate(self._entries(spec)):
+                    vname = name or (slot if len(self._entries(spec)) == 1
+                                     else f"{slot}_{i}")
+                    dtype = str(arr.dtype)
+                    block.create_var(
+                        name=vname, shape=list(arr.shape), dtype=dtype,
+                        lod_level=1 if lod is not None else 0,
+                        stop_gradient=False)
+                    feed[vname] = LoDTensor(arr, lod) if lod is not None else arr
+                    names.append(vname)
+                in_map[slot] = names
+            for slot, spec in self.outputs.items():
+                names = []
+                for i, (name, arr, lod) in enumerate(self._entries(spec)):
+                    vname = name or (slot if len(self._entries(spec)) == 1
+                                     else f"{slot}_{i}")
+                    block.create_var(
+                        name=vname, shape=list(arr.shape), dtype=str(arr.dtype),
+                        lod_level=1 if lod is not None else 0)
+                    names.append(vname)
+                out_map[slot] = names
+            block.append_op(
+                type=self.op_type, inputs=in_map, outputs=out_map,
+                attrs=dict(self.attrs))
+        return prog, feed, in_map, out_map
+
+    # ------------------------------------------------------------------
+    def check_output(self, atol=None, no_check_set=()):
+        self.setup()
+        atol = atol if atol is not None else self.atol
+        prog, feed, _, out_map = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        fetch_names = [n for slot, names in out_map.items()
+                       if slot not in no_check_set for n in names]
+        outs = exe.run(prog, feed=feed, fetch_list=fetch_names,
+                       return_numpy=False)
+        got = dict(zip(fetch_names, outs))
+        for slot, spec in self.outputs.items():
+            if slot in no_check_set:
+                continue
+            for (name, want, lod), vname in zip(
+                    self._entries(spec), out_map[slot]):
+                have = got[vname]
+                have_np = _as_np(have)
+                assert have_np.shape == want.shape or want.size == have_np.size, (
+                    f"{self.op_type}.{slot}: shape {have_np.shape} vs "
+                    f"expected {want.shape}")
+                np.testing.assert_allclose(
+                    have_np.reshape(want.shape).astype(np.float64)
+                    if want.dtype.kind == "f" else have_np.reshape(want.shape),
+                    want, atol=atol, rtol=self.rtol,
+                    err_msg=f"{self.op_type} output {slot}/{vname}")
+
+    # ------------------------------------------------------------------
+    def check_grad(self, inputs_to_check, output_names, max_relative_error=0.005,
+                   numeric_delta=5e-3, no_grad_set=None):
+        """Analytic grad (IR grad ops) vs numeric finite difference of
+        mean(output)."""
+        self.setup()
+        if isinstance(output_names, str):
+            output_names = [output_names]
+        prog, feed, in_map, out_map = self._build()
+
+        with program_guard(prog):
+            block = prog.global_block()
+            # loss = sum over checked outputs of mean(out)
+            mean_names = []
+            for on in output_names:
+                mv = block.create_var(
+                    name=f"{on}@MEAN", shape=[1], dtype="float32")
+                block.append_op(type="mean", inputs={"X": [on]},
+                                outputs={"Out": [mv.name]}, attrs={})
+                mean_names.append(mv.name)
+            loss_name = mean_names[0]
+            if len(mean_names) > 1:
+                loss = block.create_var(name="loss@SUM", shape=[1],
+                                        dtype="float32")
+                block.append_op(type="sum", inputs={"X": mean_names},
+                                outputs={"Out": [loss.name]}, attrs={})
+                loss_name = loss.name
+            grads = backward.calc_gradient(
+                [prog.global_block().var(loss_name)],
+                [prog.global_block().var(n) for n in inputs_to_check],
+                no_grad_set=no_grad_set)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        analytic = exe.run(prog, feed=feed,
+                           fetch_list=[g for g in grads], return_numpy=False)
+        analytic = [_as_np(a) for a in analytic]
+
+        # numeric: rebuild the pure forward program (no grad ops)
+        fprog, ffeed, _, _ = self._build()
+        with program_guard(fprog):
+            block = fprog.global_block()
+            mean_names = []
+            for on in output_names:
+                mv = block.create_var(name=f"{on}@MEAN", shape=[1],
+                                      dtype="float32")
+                block.append_op(type="mean", inputs={"X": [on]},
+                                outputs={"Out": [mv.name]}, attrs={})
+                mean_names.append(mv.name)
+
+        def loss_at(feed_dict):
+            outs = exe.run(fprog, feed=feed_dict, fetch_list=mean_names)
+            return float(sum(np.asarray(o).sum() for o in outs))
+
+        for vname, a_grad in zip(inputs_to_check, analytic):
+            base = np.asarray(feed[vname].numpy() if isinstance(
+                feed[vname], LoDTensor) else feed[vname])
+            lod = feed[vname].lod() if isinstance(feed[vname], LoDTensor) else None
+            num = np.zeros_like(base, dtype=np.float64)
+            flat = base.reshape(-1)
+            for i in range(flat.size):
+                orig = flat[i]
+                for sign, store in ((1.0, "p"), (-1.0, "m")):
+                    flat[i] = orig + sign * numeric_delta
+                    f2 = dict(feed)
+                    f2[vname] = (LoDTensor(base.copy(), lod)
+                                 if lod is not None else base.copy())
+                    val = loss_at(f2)
+                    if store == "p":
+                        plus = val
+                    else:
+                        minus = val
+                flat[i] = orig
+                num.reshape(-1)[i] = (plus - minus) / (2 * numeric_delta)
+            a = np.asarray(a_grad, dtype=np.float64).reshape(num.shape)
+            denom = np.maximum(np.maximum(np.abs(a), np.abs(num)), 1e-3)
+            rel = np.abs(a - num) / denom
+            assert rel.max() <= max_relative_error, (
+                f"{self.op_type} grad wrt {vname}: max rel err "
+                f"{rel.max():.5f} > {max_relative_error} "
+                f"(analytic {a.reshape(-1)[rel.argmax()]}, "
+                f"numeric {num.reshape(-1)[rel.argmax()]})")

@@ -1,0 +1,71 @@
+"""IR-level autodiff: append_backward / calc_gradient.
+
+Reference: python/paddle/fluid/backward.py (append_backward:434,
+calc_gradient:604) exercised by unittests/test_backward.py and every op's
+check_grad.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import backward
+from paddle_tpu.core.framework import Program, program_guard, grad_var_name
+
+
+def _run(prog, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(prog, feed=feed, fetch_list=fetch)
+
+
+def test_append_backward_creates_grad_ops():
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=2)
+        loss = fluid.layers.mean(y)
+        params_grads = backward.append_backward(loss)
+        prog = fluid.default_main_program()
+    assert len(params_grads) == 2  # fc weight + bias
+    types = [op.type for op in prog.global_block().ops]
+    assert any(t.endswith("_grad") for t in types)
+    for p, g in params_grads:
+        assert g.name == grad_var_name(p.name)
+
+
+def test_grad_dedup_sums_repeated_use():
+    """x used twice -> its grad is the sum of both paths
+    (reference backward.py:123 _addup_repetitive_outputs_)."""
+    with program_guard(Program()):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        x.stop_gradient = False
+        y = fluid.layers.elementwise_add(x, x)  # dy/dx = 2
+        loss = fluid.layers.reduce_sum(y)
+        grads = backward.calc_gradient([loss], [x])
+        prog = fluid.default_main_program()
+    g, = _run(prog, {"x": np.ones((2, 3), dtype="float32")}, grads)
+    np.testing.assert_allclose(g, 2 * np.ones((2, 3)), atol=1e-6)
+
+
+def test_calc_gradient_chain():
+    with program_guard(Program()):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        x.stop_gradient = False
+        y = fluid.layers.scale(x, scale=3.0)
+        z = fluid.layers.reduce_sum(fluid.layers.square(y))
+        grads = backward.calc_gradient([z], [x])
+        prog = fluid.default_main_program()
+    xv = np.arange(6, dtype="float32").reshape(2, 3)
+    g, = _run(prog, {"x": xv}, grads)
+    np.testing.assert_allclose(g, 2 * 9 * xv, rtol=1e-5)
+
+
+def test_stop_gradient_blocks_backprop():
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4)
+        h.stop_gradient = True
+        y = fluid.layers.fc(input=h, size=2)
+        loss = fluid.layers.mean(y)
+        params_grads = backward.append_backward(loss)
+    grad_names = {p.name for p, g in params_grads}
+    # first fc's params get no grads (cut by stop_gradient)
+    assert len(params_grads) == 2

@@ -1,0 +1,99 @@
+"""End-to-end "book" test: digit recognition MLP + conv net converge.
+
+Reference: tests/book/test_recognize_digits.py — build a real model, train a
+few iterations on real-ish data, assert the loss decreases below a threshold,
+round-trip an inference model (SURVEY.md §4.4).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program, program_guard
+
+
+def synthetic_digits(n, seed=0):
+    """Linearly-separable 'digits': class k has mean pattern k."""
+    rs = np.random.RandomState(seed)
+    protos = rs.rand(10, 784).astype("float32")
+    ys = rs.randint(0, 10, n).astype("int64")
+    xs = protos[ys] + 0.1 * rs.randn(n, 784).astype("float32")
+    return xs.astype("float32"), ys.reshape(-1, 1)
+
+
+def mlp(img, label):
+    h = fluid.layers.fc(input=img, size=64, act="relu")
+    pred = fluid.layers.fc(input=h, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    acc = fluid.layers.accuracy(input=pred, label=label)
+    return pred, loss, acc
+
+
+def conv_net(img, label):
+    img2 = fluid.layers.reshape(img, [-1, 1, 28, 28])
+    c1 = fluid.nets.simple_img_conv_pool(
+        input=img2, filter_size=5, num_filters=8, pool_size=2,
+        pool_stride=2, act="relu")
+    pred = fluid.layers.fc(input=c1, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    acc = fluid.layers.accuracy(input=pred, label=label)
+    return pred, loss, acc
+
+
+@pytest.mark.parametrize("net", [mlp, conv_net], ids=["mlp", "conv"])
+def test_train_converges(net):
+    with program_guard(Program(), Program()):
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        pred, loss, acc = net(img, label)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        main, startup = fluid.default_main_program(), \
+            fluid.default_startup_program()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs, ys = synthetic_digits(256)
+    first = last = None
+    for i in range(30):
+        j = (i * 32) % 256
+        lv, av = exe.run(main, feed={"img": xs[j:j + 32],
+                                     "label": ys[j:j + 32]},
+                         fetch_list=[loss, acc])
+        lv = float(np.asarray(lv).item())
+        if first is None:
+            first = lv
+        last = lv
+    assert last < first, (first, last)
+    assert last < 1.5, last
+
+
+def test_inference_model_roundtrip():
+    with program_guard(Program(), Program()):
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        pred, loss, acc = mlp(img, label)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        main, startup = fluid.default_main_program(), \
+            fluid.default_startup_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs, ys = synthetic_digits(32)
+    exe.run(main, feed={"img": xs, "label": ys}, fetch_list=[loss])
+    ref, = exe.run(main.clone(for_test=True), feed={"img": xs},
+                   fetch_list=[pred])
+
+    with tempfile.TemporaryDirectory() as d:
+        fluid.io.save_inference_model(d, ["img"], [pred], exe,
+                                      main_program=main)
+        with program_guard(Program()):
+            [infer_prog, feed_names, fetch_vars] = \
+                fluid.io.load_inference_model(d, exe)
+        got, = exe.run(infer_prog, feed={feed_names[0]: xs},
+                       fetch_list=fetch_vars)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-4)

@@ -1,0 +1,43 @@
+"""Control-flow ops: While, conditional sub-blocks, tensor arrays.
+
+Reference: layers/control_flow.py (While:608, array ops), while_op.cc:35.
+Also a regression test: DCE must never prune control-flow ops (their outputs
+are written into the trace env by side effect).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program, program_guard
+
+
+def test_while_loop_accumulates():
+    with program_guard(Program(), Program()):
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype="int64", value=5)
+        acc = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            new_acc = fluid.layers.elementwise_add(
+                acc, fluid.layers.fill_constant(
+                    shape=[1], dtype="float32", value=2.0))
+            fluid.layers.assign(new_acc, acc)
+            fluid.layers.increment(x=i, value=1, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+        main = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    out, = exe.run(main, feed={}, fetch_list=[acc])
+    np.testing.assert_allclose(np.asarray(out), [10.0], atol=1e-6)
+
+
+def test_array_write_read():
+    with program_guard(Program(), Program()):
+        x = fluid.layers.fill_constant(shape=[2], dtype="float32", value=3.0)
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        arr = fluid.layers.array_write(x, i)
+        read = fluid.layers.array_read(arr, i)
+        main = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    out, = exe.run(main, feed={}, fetch_list=[read])
+    np.testing.assert_allclose(np.asarray(out), [3.0, 3.0], atol=1e-6)

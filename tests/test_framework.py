@@ -1,0 +1,95 @@
+"""Program/Block/Operator/Variable IR semantics.
+
+Reference: unittests/test_program.py, test_operator_desc.py,
+test_variable.py (SURVEY.md §4.3 program-construction tests).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import (
+    Program, program_guard, default_main_program, default_startup_program,
+    grad_var_name, OpRole)
+
+
+def test_program_guard():
+    p = Program()
+    with program_guard(p):
+        assert default_main_program() is p
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        assert x.name in p.global_block().vars
+    assert default_main_program() is not p
+
+
+def test_variable_shapes_and_dtype():
+    prog = Program()
+    b = prog.global_block()
+    v = b.create_var(name="v", shape=[3, 4], dtype="float32")
+    assert v.shape == (3, 4) or list(v.shape) == [3, 4]
+    assert v.dtype == "float32"
+    assert b.var("v") is v
+
+
+def test_append_op_and_arg_names():
+    prog = Program()
+    b = prog.global_block()
+    b.create_var(name="x", shape=[2, 2], dtype="float32")
+    b.create_var(name="y", shape=[2, 2], dtype="float32")
+    b.create_var(name="o", shape=[2, 2], dtype="float32")
+    op = b.append_op(type="elementwise_add", inputs={"X": ["x"], "Y": ["y"]},
+                     outputs={"Out": ["o"]}, attrs={})
+    assert op.type == "elementwise_add"
+    assert set(op.input_arg_names()) == {"x", "y"}
+    assert set(op.output_arg_names()) == {"o"}
+
+
+def test_program_clone_for_test_strips_dropout_randomness():
+    with program_guard(Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4)
+        d = fluid.layers.dropout(h, dropout_prob=0.5)
+        loss = fluid.layers.mean(d)
+        test_prog = default_main_program().clone(for_test=True)
+    # cloned program has the same ops, and is a distinct object graph
+    assert test_prog is not default_main_program()
+    types = [op.type for op in test_prog.global_block().ops]
+    assert "dropout" in types or "scale" in types
+
+
+def test_program_prune_removes_unreached_ops():
+    with program_guard(Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        a = fluid.layers.fc(input=x, size=4)
+        b = fluid.layers.fc(input=x, size=4)  # not reachable from target
+        loss = fluid.layers.mean(a)
+        prog = default_main_program()
+        pruned = prog.prune([loss])
+    n_pruned = len(pruned.global_block().ops)
+    n_full = len(prog.global_block().ops)
+    assert n_pruned < n_full
+
+
+def test_grad_var_name():
+    assert grad_var_name("w") == "w@GRAD"
+
+
+def test_op_roles_marked_by_optimizer():
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        roles = {op.attrs.get("op_role") for op in
+                 default_main_program().global_block().ops}
+    assert OpRole.Backward in roles
+    assert OpRole.Optimize in roles
+
+
+def test_program_serialization_roundtrip():
+    with program_guard(Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3, act="relu")
+        prog = default_main_program()
+    s = prog.to_string()
+    assert "fc" in s or "mul" in s

@@ -1,0 +1,181 @@
+"""Elementwise / activation / math op numerics + gradients.
+
+Reference: unittests/test_elementwise_*_op.py, test_activation_op.py.
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_add"
+        x = np.random.RandomState(0).rand(3, 4).astype("float32")
+        y = np.random.RandomState(1).rand(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBcast(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_add"
+        x = np.random.RandomState(0).rand(2, 3, 4).astype("float32")
+        y = np.random.RandomState(1).rand(3,).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+class TestElementwiseMul(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_mul"
+        x = np.random.RandomState(0).rand(3, 4).astype("float32") + 0.5
+        y = np.random.RandomState(1).rand(3, 4).astype("float32") + 0.5
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x * y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseDiv(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_div"
+        x = np.random.RandomState(0).rand(3, 4).astype("float32") + 0.5
+        y = np.random.RandomState(1).rand(3, 4).astype("float32") + 0.5
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+class TestElementwiseMax(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_max"
+        x = np.random.RandomState(0).rand(3, 4).astype("float32")
+        y = np.random.RandomState(1).rand(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.maximum(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+
+@pytest.mark.parametrize(
+    "op_type,fn,grad",
+    [
+        ("relu", lambda x: np.maximum(x, 0), True),
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), True),
+        ("tanh", np.tanh, True),
+        ("exp", np.exp, True),
+        ("log", np.log, True),
+        ("sqrt", np.sqrt, True),
+        ("square", np.square, True),
+        ("abs", np.abs, False),
+        ("floor", np.floor, False),
+        ("ceil", np.ceil, False),
+        ("reciprocal", lambda x: 1 / x, True),
+        ("softsign", lambda x: x / (1 + np.abs(x)), True),
+        ("softplus", lambda x: np.log(1 + np.exp(x)), True),
+    ],
+)
+def test_activation(op_type, fn, grad):
+    class T(OpTest):
+        def setup(self):
+            self.op_type = op_type
+            x = np.random.RandomState(0).rand(3, 4).astype("float32") + 0.5
+            self.inputs = {"X": x}
+            self.outputs = {"Out": fn(x)}
+
+    t = T()
+    t.rtol = 1e-3  # XLA CPU uses fast transcendental approximations
+    t.check_output(atol=1e-4)
+    if grad:
+        t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestScale(OpTest):
+    def setup(self):
+        self.op_type = "scale"
+        x = np.random.RandomState(0).rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 0.5}
+        self.outputs = {"Out": x * 2.5 + 0.5}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestClip(OpTest):
+    def setup(self):
+        self.op_type = "clip"
+        x = np.random.RandomState(0).uniform(-1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"min": -0.3, "max": 0.3}
+        self.outputs = {"Out": np.clip(x, -0.3, 0.3)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSum(OpTest):
+    def setup(self):
+        self.op_type = "sum"
+        xs = [np.random.RandomState(i).rand(3, 4).astype("float32")
+              for i in range(3)]
+        self.inputs = {"X": [(f"x{i}", x) for i, x in enumerate(xs)]}
+        self.outputs = {"Out": sum(xs)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCast(OpTest):
+    def setup(self):
+        self.op_type = "cast"
+        x = np.random.RandomState(0).rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"out_dtype": "int32", "in_dtype": "float32"}
+        self.outputs = {"Out": x.astype("int32")}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPow(OpTest):
+    def setup(self):
+        self.op_type = "pow"
+        x = np.random.RandomState(0).rand(3, 4).astype("float32") + 0.5
+        self.inputs = {"X": x}
+        self.attrs = {"factor": 3.0}
+        self.outputs = {"Out": x ** 3.0}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.02)

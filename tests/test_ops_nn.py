@@ -1,0 +1,296 @@
+"""NN op numerics: matmul/mul, softmax, cross_entropy, conv2d, pool2d,
+batch_norm, layer_norm, dropout, lookup_table.
+
+Reference: unittests/test_mul_op.py, test_softmax_op.py, test_conv2d_op.py,
+test_pool2d_op.py, test_batch_norm_op.py, test_layer_norm_op.py,
+test_lookup_table_op.py, test_cross_entropy_op.py.
+"""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestMul(OpTest):
+    def setup(self):
+        self.op_type = "mul"
+        x = np.random.RandomState(0).rand(4, 5).astype("float32")
+        y = np.random.RandomState(1).rand(5, 3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+class TestMulFlatten(OpTest):
+    """mul flattens X to 2-D by x_num_col_dims (reference mul_op.cc)."""
+
+    def setup(self):
+        self.op_type = "mul"
+        x = np.random.RandomState(0).rand(2, 3, 4).astype("float32")
+        y = np.random.RandomState(1).rand(12, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": x.reshape(2, 12) @ y}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestMatmul(OpTest):
+    def setup(self):
+        self.op_type = "matmul"
+        x = np.random.RandomState(0).rand(2, 3, 4).astype("float32")
+        y = np.random.RandomState(1).rand(2, 4, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestMatmulTranspose(OpTest):
+    def setup(self):
+        self.op_type = "matmul"
+        x = np.random.RandomState(0).rand(4, 3).astype("float32")
+        y = np.random.RandomState(1).rand(5, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.outputs = {"Out": x.T @ y.T}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestSoftmax(OpTest):
+    def setup(self):
+        self.op_type = "softmax"
+        x = np.random.RandomState(0).rand(3, 7).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np_softmax(x)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestCrossEntropy(OpTest):
+    def setup(self):
+        self.op_type = "cross_entropy"
+        rs = np.random.RandomState(0)
+        probs = np_softmax(rs.rand(5, 4).astype("float32"))
+        labels = rs.randint(0, 4, (5, 1)).astype("int64")
+        out = -np.log(probs[np.arange(5), labels.flatten()]).reshape(5, 1)
+        self.inputs = {"X": probs, "Label": labels}
+        self.outputs = {"Y": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    def setup(self):
+        self.op_type = "softmax_with_cross_entropy"
+        rs = np.random.RandomState(0)
+        logits = rs.rand(5, 4).astype("float32") * 4
+        labels = rs.randint(0, 4, (5, 1)).astype("int64")
+        sm = np_softmax(logits)
+        loss = -np.log(sm[np.arange(5), labels.flatten()]).reshape(5, 1)
+        self.inputs = {"Logits": logits, "Label": labels}
+        self.outputs = {"Softmax": sm, "Loss": loss.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestConv2d(OpTest):
+    def setup(self):
+        self.op_type = "conv2d"
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 3, 5, 5).astype("float32")  # NCHW
+        w = rs.rand(4, 3, 3, 3).astype("float32")  # OIHW
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+        out = np.zeros((2, 4, 5, 5), dtype="float64")
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        for n in range(2):
+            for o in range(4):
+                for i in range(5):
+                    for j in range(5):
+                        out[n, o, i, j] = (
+                            xp[n, :, i:i + 3, j:j + 3] * w[o]).sum()
+        self.outputs = {"Output": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-3)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.03, numeric_delta=1e-2)
+
+
+class TestDepthwiseConv2d(OpTest):
+    def setup(self):
+        self.op_type = "depthwise_conv2d"
+        rs = np.random.RandomState(0)
+        x = rs.rand(1, 2, 4, 4).astype("float32")
+        w = rs.rand(2, 1, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 2}
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        out = np.zeros((1, 2, 4, 4), dtype="float64")
+        for c in range(2):
+            for i in range(4):
+                for j in range(4):
+                    out[0, c, i, j] = (xp[0, c, i:i + 3, j:j + 3] * w[c, 0]).sum()
+        self.outputs = {"Output": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-3)
+
+
+class TestPool2dMax(OpTest):
+    def setup(self):
+        self.op_type = "pool2d"
+        # well-separated values so finite differences can't flip the argmax
+        rs = np.random.RandomState(0)
+        x = (rs.permutation(2 * 3 * 4 * 4).astype("float32") * 0.1
+             ).reshape(2, 3, 4, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+        out = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.02,
+                        numeric_delta=1e-2)
+
+
+class TestPool2dAvg(OpTest):
+    def setup(self):
+        self.op_type = "pool2d"
+        x = np.random.RandomState(0).rand(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+        out = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPool2dGlobal(OpTest):
+    def setup(self):
+        self.op_type = "pool2d"
+        x = np.random.RandomState(0).rand(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [0, 0],
+                      "strides": [1, 1], "paddings": [0, 0],
+                      "global_pooling": True}
+        self.outputs = {"Out": x.mean(axis=(2, 3), keepdims=True)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBatchNormInference(OpTest):
+    def setup(self):
+        self.op_type = "batch_norm"
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 3, 4, 4).astype("float32")
+        scale = rs.rand(3).astype("float32")
+        bias = rs.rand(3).astype("float32")
+        mean = rs.rand(3).astype("float32")
+        var = rs.rand(3).astype("float32") + 0.5
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"is_test": True, "epsilon": 1e-5, "momentum": 0.9,
+                      "data_layout": "NCHW"}
+        m = mean.reshape(1, 3, 1, 1)
+        v = var.reshape(1, 3, 1, 1)
+        y = (x - m) / np.sqrt(v + 1e-5) * scale.reshape(1, 3, 1, 1) \
+            + bias.reshape(1, 3, 1, 1)
+        self.outputs = {"Y": y.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, no_check_set=(
+            "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"))
+
+
+class TestLayerNorm(OpTest):
+    def setup(self):
+        self.op_type = "layer_norm"
+        rs = np.random.RandomState(0)
+        x = rs.rand(3, 8).astype("float32")
+        scale = rs.rand(8).astype("float32")
+        bias = rs.rand(8).astype("float32")
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": 1e-5, "begin_norm_axis": 1}
+        mu = x.mean(axis=1, keepdims=True)
+        var = x.var(axis=1, keepdims=True)
+        y = (x - mu) / np.sqrt(var + 1e-5) * scale + bias
+        self.outputs = {"Y": y.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, no_check_set=("Mean", "Variance"))
+
+
+class TestLookupTable(OpTest):
+    def setup(self):
+        self.op_type = "lookup_table"
+        rs = np.random.RandomState(0)
+        table = rs.rand(10, 6).astype("float32")
+        ids = rs.randint(0, 10, (4, 1)).astype("int64")
+        self.inputs = {"W": table, "Ids": ids}
+        self.outputs = {"Out": table[ids.flatten()]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTopK(OpTest):
+    def setup(self):
+        self.op_type = "top_k"
+        x = np.random.RandomState(0).rand(3, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"k": 2}
+        idx = np.argsort(-x, axis=1)[:, :2]
+        self.outputs = {"Out": np.take_along_axis(x, idx, 1),
+                        "Indices": idx.astype("int64")}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestAccuracy(OpTest):
+    def setup(self):
+        self.op_type = "accuracy"
+        rs = np.random.RandomState(0)
+        pred = np_softmax(rs.rand(6, 4).astype("float32"))
+        idx = np.argsort(-pred, axis=1)[:, :1]
+        label = rs.randint(0, 4, (6, 1)).astype("int64")
+        acc = (idx[:, 0] == label[:, 0]).mean()
+        self.inputs = {"Out": pred, "Indices": idx.astype("int64"),
+                       "Label": label}
+        self.outputs = {"Accuracy": np.array([acc], dtype="float32")}
+
+    def test_output(self):
+        self.check_output(no_check_set=("Correct", "Total"))
