@@ -1,0 +1,150 @@
+"""Benchmark driver (reference benchmark/fluid/fluid_benchmark.py).
+
+Trains a model from paddle_tpu.models and reports images/sec or words/sec.
+
+  python benchmark/fluid_benchmark.py --model mnist --batch_size 128 \
+      --iterations 50 [--device TPU|CPU] [--parallel] [--profile]
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def parse_args():
+    parser = argparse.ArgumentParser("paddle_tpu model benchmarks")
+    parser.add_argument("--model", type=str, default="mnist",
+                        choices=["mnist", "resnet", "vgg", "se_resnext",
+                                 "stacked_dynamic_lstm",
+                                 "machine_translation"])
+    parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--learning_rate", type=float, default=0.001)
+    parser.add_argument("--iterations", type=int, default=50)
+    parser.add_argument("--pass_num", type=int, default=1)
+    parser.add_argument("--device", type=str, default="TPU",
+                        choices=["CPU", "TPU", "GPU"])
+    parser.add_argument("--data_set", type=str, default="cifar10",
+                        choices=["cifar10", "flowers", "imagenet"])
+    parser.add_argument("--infer_only", action="store_true")
+    parser.add_argument("--use_fake_data", action="store_true",
+                        help="feed one cached batch repeatedly (pure "
+                             "device throughput, reference --use_fake_data)")
+    parser.add_argument("--profile", action="store_true")
+    parser.add_argument("--parallel", action="store_true",
+                        help="ParallelExecutor over all visible devices")
+    parser.add_argument("--skip_batch_num", type=int, default=5,
+                        help="warmup batches excluded from timing")
+    return parser.parse_args()
+
+
+def feed_dict_from_batch(batch, model_name):
+    """Convert a batch of dataset samples into a feed dict."""
+    if model_name in ("mnist",):
+        imgs = np.stack([s[0] for s in batch]).astype("float32")
+        labels = np.array([s[1] for s in batch], dtype="int64").reshape(-1, 1)
+        return {"pixel": imgs, "label": labels}
+    if model_name in ("resnet", "se_resnext"):
+        imgs = np.stack([s[0].reshape(3, 32, 32) if s[0].size == 3072
+                         else s[0].reshape(3, 224, 224)
+                         for s in batch]).astype("float32")
+        labels = np.array([s[1] for s in batch], dtype="int64").reshape(-1, 1)
+        return {"data": imgs, "label": labels}
+    if model_name == "vgg":
+        imgs = np.stack([s[0].reshape(3, 32, 32) if s[0].size == 3072
+                         else s[0].reshape(3, 224, 224)
+                         for s in batch]).astype("float32")
+        labels = np.array([s[1] for s in batch], dtype="int64").reshape(-1, 1)
+        return {"pixel": imgs, "label": labels}
+    if model_name == "stacked_dynamic_lstm":
+        words = fluid.create_lod_tensor(
+            np.concatenate([np.asarray(s[0], dtype="int64")
+                            for s in batch]).reshape(-1, 1),
+            [[len(s[0]) for s in batch]], fluid.CPUPlace())
+        labels = np.array([s[1] for s in batch], dtype="int64").reshape(-1, 1)
+        return {"words": words, "label": labels}
+    if model_name == "machine_translation":
+        def lod(idx):
+            return fluid.create_lod_tensor(
+                np.concatenate([np.asarray(s[idx], dtype="int64")
+                                for s in batch]).reshape(-1, 1),
+                [[len(s[idx]) for s in batch]], fluid.CPUPlace())
+        return {"source_sequence": lod(0), "target_sequence": lod(1),
+                "label_sequence": lod(2)}
+    raise ValueError(model_name)
+
+
+def tokens_in_batch(batch, model_name):
+    if model_name == "stacked_dynamic_lstm":
+        return sum(len(s[0]) for s in batch)
+    if model_name == "machine_translation":
+        return sum(len(s[1]) for s in batch)
+    return len(batch)
+
+
+def train(args):
+    import paddle_tpu.models as models
+
+    get_model = models.get_model(args.model)
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        avg_cost, infer_prog, optimizer, train_reader, test_reader, \
+            batch_acc = get_model(args)
+        if not args.infer_only:
+            optimizer.minimize(avg_cost)
+
+    place = fluid.CPUPlace() if args.device == "CPU" else fluid.TPUPlace(0)
+    if args.parallel:
+        exe = fluid.ParallelExecutor(
+            use_cuda=False, loss_name=avg_cost.name, main_program=main)
+        startup_exe = fluid.Executor(place)
+        startup_exe.run(startup)
+    else:
+        exe = fluid.Executor(place)
+        exe.run(startup)
+
+    fetches = [avg_cost] if batch_acc is None else [avg_cost, batch_acc]
+    is_seq = args.model in ("stacked_dynamic_lstm", "machine_translation")
+    unit = "words/s" if is_seq else "images/s"
+
+    batches = []
+    for i, batch in enumerate(train_reader()):
+        if len(batches) * args.batch_size >= \
+                (args.iterations + args.skip_batch_num) * args.batch_size:
+            break
+        if len(batch) == args.batch_size:
+            batches.append(batch)
+    if args.use_fake_data:
+        batches = [batches[0]] * (args.iterations + args.skip_batch_num)
+
+    count = 0.0
+    elapsed = 0.0
+    loss = None
+    for it, batch in enumerate(batches):
+        feed = feed_dict_from_batch(batch, args.model)
+        t0 = time.time()
+        outs = exe.run(main if not args.parallel else None,
+                       feed=feed, fetch_list=fetches) \
+            if not args.parallel else exe.run(feed=feed, fetch_list=fetches)
+        loss = float(np.asarray(outs[0]).mean())
+        dt = time.time() - t0
+        if it >= args.skip_batch_num:
+            elapsed += dt
+            count += tokens_in_batch(batch, args.model)
+        if it % 10 == 0:
+            print(f"iter {it} loss {loss:.4f} ({dt*1000:.1f} ms)",
+                  file=sys.stderr)
+    throughput = count / max(elapsed, 1e-9)
+    return {"metric": f"{args.model}_{unit}", "value": round(throughput, 2),
+            "unit": unit, "loss": round(loss, 4)}
+
+
+if __name__ == "__main__":
+    args = parse_args()
+    result = train(args)
+    import json
+    print(json.dumps(result))

@@ -85,6 +85,10 @@ from .inferencer import Inferencer
 from . import transpiler
 from .transpiler import DistributeTranspiler, InferenceTranspiler, memory_optimize, release_memory
 from .unique_name import generate as _generate_unique_name
+from . import unique_name
+from . import reader
+from . import dataset
+from .minibatch import batch
 
 Tensor = LoDTensor
 
@@ -103,4 +107,5 @@ __all__ = [
     "ParamAttr", "WeightNormParamAttr", "DataFeeder",
     "Trainer", "Inferencer", "transpiler", "DistributeTranspiler",
     "InferenceTranspiler", "memory_optimize", "release_memory",
+    "reader", "dataset", "batch", "unique_name",
 ]

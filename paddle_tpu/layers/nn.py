@@ -27,6 +27,7 @@ __all__ = [
     "label_smooth", "roi_pool", "dice_loss", "upsampling_bilinear2d",
     "random_crop", "linear_chain_crf", "crf_decoding", "edit_distance",
     "ctc_greedy_decoder", "sigmoid_cross_entropy_with_logits", "squeeze",
+    "attention_lstm_decoder",
 ]
 
 
@@ -103,8 +104,10 @@ def dynamic_lstm(input, size, param_attr=None, bias_attr=None, use_peepholes=Tru
     bias_size = [1, 7 * size] if use_peepholes else [1, 4 * size]
     bias = helper.create_parameter(helper.bias_attr or ParamAttr(), shape=bias_size,
                                    dtype=dtype, is_bias=True)
-    hidden = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
-    cell = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
+    hidden = helper.create_tmp_variable(
+        dtype, shape=(-1, size), lod_level=input.lod_level)
+    cell = helper.create_tmp_variable(
+        dtype, shape=(-1, size), lod_level=input.lod_level)
     inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
     if h_0 is not None:
         inputs["H0"] = [h_0]
@@ -150,7 +153,8 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None, is_reverse=False,
     weight = helper.create_parameter(helper.param_attr, shape=[size, 3 * size], dtype=dtype)
     bias = helper.create_parameter(helper.bias_attr or ParamAttr(), shape=[1, 3 * size],
                                    dtype=dtype, is_bias=True)
-    hidden = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
+    hidden = helper.create_tmp_variable(
+        dtype, shape=(-1, size), lod_level=input.lod_level)
     inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
     if h_0 is not None:
         inputs["H0"] = [h_0]
@@ -330,7 +334,8 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1, padding=No
     dtype = helper.input_dtype()
     filter_shape = [filter_size * input.shape[-1], num_filters]
     filter_param = helper.create_parameter(helper.param_attr, filter_shape, dtype)
-    pre_bias = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
+    pre_bias = helper.create_tmp_variable(
+        dtype, shape=(-1, num_filters), lod_level=input.lod_level)
     helper.append_op(
         "sequence_conv",
         {"X": [input], "Filter": [filter_param]},
@@ -812,7 +817,8 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
     dtype = helper.input_dtype()
     filter_shape = [future_context_size + 1, input.shape[-1]]
     filter_param = helper.create_parameter(helper.param_attr, filter_shape, dtype)
-    out = helper.create_tmp_variable(dtype, lod_level=input.lod_level)
+    out = helper.create_tmp_variable(
+        dtype, shape=tuple(input.shape), lod_level=input.lod_level)
     helper.append_op("row_conv", {"X": [input], "Filter": [filter_param]}, {"Out": [out]})
     return helper.append_activation(out)
 
@@ -1146,3 +1152,59 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None):
         {"num_classes": num_classes},
     )
     return out
+
+
+def attention_lstm_decoder(target_embedding, encoder_vec, encoder_proj,
+                           decoder_boot, decoder_size, target_dict_dim,
+                           param_attr=None, dtype="float32", name=None,
+                           max_target_len=None, max_source_len=None):
+    """Teacher-forced attention LSTM decoder over a ragged target sequence —
+    fused-scan replacement for the reference's DynamicRNN decoder
+    (benchmark/fluid/models/machine_translation.py:104-152)."""
+    import copy as _copy
+
+    helper = LayerHelper("attention_lstm_decoder", **locals())
+    emb_dim = target_embedding.shape[-1]
+    enc_dim = encoder_vec.shape[-1]
+    d = decoder_size
+
+    def _attr():
+        # distinct copy per parameter: create_parameter mutates attr.name
+        return _copy.deepcopy(helper.param_attr)
+
+    w_att_state = helper.create_parameter(
+        _attr(), shape=[d, d], dtype=dtype)
+    w_att_score = helper.create_parameter(
+        _attr(), shape=[2 * d, 1], dtype=dtype)
+    w_step = helper.create_parameter(
+        _attr(), shape=[d + enc_dim + emb_dim, 4 * d], dtype=dtype)
+    b_step = helper.create_parameter(
+        ParamAttr(), shape=[1, 4 * d], dtype=dtype, is_bias=True)
+    w_out = helper.create_parameter(
+        _attr(), shape=[d, target_dict_dim], dtype=dtype)
+    b_out = helper.create_parameter(
+        ParamAttr(), shape=[1, target_dict_dim], dtype=dtype, is_bias=True)
+    pred = helper.create_tmp_variable(
+        dtype, lod_level=target_embedding.lod_level)
+    helper.append_op(
+        "attention_lstm_decoder",
+        {
+            "TargetEmb": [target_embedding],
+            "EncoderVec": [encoder_vec],
+            "EncoderProj": [encoder_proj],
+            "DecoderBoot": [decoder_boot],
+            "WAttState": [w_att_state],
+            "WAttScore": [w_att_score],
+            "WStep": [w_step],
+            "BStep": [b_step],
+            "WOut": [w_out],
+            "BOut": [b_out],
+        },
+        {"Out": [pred]},
+        {
+            "max_target_len": -1 if max_target_len is None else int(max_target_len),
+            "max_source_len": -1 if max_source_len is None else int(max_source_len),
+        },
+    )
+    pred.shape = (-1, target_dict_dim)
+    return pred

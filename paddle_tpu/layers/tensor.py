@@ -54,7 +54,18 @@ def cast(x, dtype):
 
 def concat(input, axis=0, name=None):
     helper = LayerHelper("concat", name=name)
-    out = helper.create_tmp_variable(dtype=helper.input_dtype(), lod_level=input[0].lod_level)
+    shape = None
+    if all(v.shape is not None for v in input):
+        shapes = [list(v.shape) for v in input]
+        ndim = len(shapes[0])
+        ax = axis % ndim
+        if all(len(s) == ndim for s in shapes):
+            shape = list(shapes[0])
+            dims = [s[ax] for s in shapes]
+            shape[ax] = -1 if any(d == -1 for d in dims) else sum(dims)
+            shape = tuple(shape)
+    out = helper.create_tmp_variable(
+        dtype=helper.input_dtype(), shape=shape, lod_level=input[0].lod_level)
     helper.append_op("concat", {"X": input}, {"Out": [out]}, {"axis": axis})
     return out
 

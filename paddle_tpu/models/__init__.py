@@ -1,0 +1,21 @@
+"""Benchmark model zoo (reference benchmark/fluid/models/__init__.py).
+
+Each module exposes get_model(args) -> (avg_cost, inference_program,
+optimizer, train_reader, test_reader, batch_acc). args needs .batch_size and
+.data_set ("cifar10" | "flowers" | ...).
+"""
+
+from . import mnist
+from . import resnet
+from . import vgg
+from . import se_resnext
+from . import stacked_dynamic_lstm
+from . import machine_translation
+
+__all__ = ["mnist", "resnet", "vgg", "se_resnext", "stacked_dynamic_lstm",
+           "machine_translation"]
+
+
+def get_model(name):
+    import importlib
+    return importlib.import_module(f"paddle_tpu.models.{name}").get_model

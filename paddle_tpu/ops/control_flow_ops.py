@@ -152,7 +152,7 @@ class TensorArray:
             raise NotImplementedError(
                 "tensor-array write with a traced index: use StaticRNN/"
                 "DynamicRNN (recurrent ops) for in-loop array writes")
-        i = int(i) if not hasattr(i, "shape") else int(jax.device_get(i))
+        i = _concrete_index(i)
         while len(self.items) <= i:
             self.items.append(None)
         self.items[i] = value
@@ -161,7 +161,7 @@ class TensorArray:
         if _is_traced(i):
             stacked = jnp.stack(self.items)
             return jnp.take(stacked, i.astype(jnp.int32), axis=0)
-        return self.items[int(i) if not hasattr(i, "shape") else int(jax.device_get(i))]
+        return self.items[_concrete_index(i)]
 
     def __len__(self):
         return len(self.items)
@@ -169,6 +169,16 @@ class TensorArray:
 
 def _is_traced(x):
     return isinstance(x, jax.core.Tracer)
+
+
+def _concrete_index(i):
+    """scalar OR shape-[1] index tensor -> python int (numpy deprecates
+    int() on ndim-1 arrays)."""
+    if not hasattr(i, "shape"):
+        return int(i)
+    import numpy as _np
+
+    return int(_np.asarray(jax.device_get(i)).reshape(-1)[0])
 
 
 @register_op("write_to_array", lod_aware=True)

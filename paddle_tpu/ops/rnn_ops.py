@@ -200,3 +200,88 @@ def gru_unit_op(ctx, ins, attrs):
     h = u * h_prev + (1 - u) * c
     gate = jnp.concatenate([u, r, c], axis=-1)
     return out(Gate=gate, ResetHiddenPrev=reset_h, Hidden=h)
+
+
+@register_op("attention_lstm_decoder", lod_aware=True)
+def attention_lstm_decoder_op(ctx, ins, attrs):
+    """Teacher-forced LSTM decoder with content attention over encoder
+    states — the fused-scan equivalent of the reference's DynamicRNN
+    decoder (benchmark/fluid/models/machine_translation.py:104-152:
+    per-step fc attention + sequence_expand/sequence_softmax + lstm_step).
+
+    Inputs:
+      TargetEmb   SeqTensor [Nt, E]   target word embeddings (ragged)
+      EncoderVec  SeqTensor [Ns, H_e] encoder states (ragged)
+      EncoderProj SeqTensor [Ns, D]   encoder states projected for scoring
+      DecoderBoot [B, D]              initial hidden state
+      WAttState [D, D]; WAttScore [2D, 1]         attention params
+      WStep [D+H_e+E, 4D]; BStep [1, 4D]          fused gate weights [i,f,c~,o]
+      WOut [D, V]; BOut [1, V]                    output projection
+    Output: Out SeqTensor [Nt, V] (softmax over target vocabulary).
+    Whole decode is one lax.scan over target time; every step's matmuls are
+    batched MXU ops and the attention mask keeps ragged batches exact.
+    """
+    temb = first(ins, "TargetEmb")
+    evec = first(ins, "EncoderVec")
+    eproj = first(ins, "EncoderProj")
+    boot = first(ins, "DecoderBoot")
+    w_att_state = first(ins, "WAttState")
+    w_att_score = first(ins, "WAttScore")
+    w_step = first(ins, "WStep")
+    b_step = first(ins, "BStep")
+    w_out = first(ins, "WOut")
+    b_out = first(ins, "BOut")
+
+    d = boot.shape[-1]
+    Tt = attrs.get("max_target_len", -1)
+    if Tt is None or Tt < 0:
+        Tt = int(temb.ntokens)
+    Ts = attrs.get("max_source_len", -1)
+    if Ts is None or Ts < 0:
+        Ts = int(evec.ntokens)
+
+    tp = seq_to_padded(temb, Tt)            # [B,Tt,E]
+    ep = seq_to_padded(evec, Ts)            # [B,Ts,He]
+    pp = seq_to_padded(eproj, Ts)           # [B,Ts,D]
+    B = tp.shape[0]
+    src_mask = (jnp.arange(Ts)[None, :] <
+                evec.lengths[:, None]).astype(tp.dtype)   # [B,Ts]
+    tgt_len = temb.lengths
+
+    h0 = boot
+    c0 = jnp.zeros((B, d), tp.dtype)
+    xs = jnp.swapaxes(tp, 0, 1)             # [Tt,B,E]
+    ts = jnp.arange(Tt)
+
+    def attention(h):
+        sp = _mm(h, w_att_state)            # [B,D]
+        cat = jnp.concatenate(
+            [pp, jnp.broadcast_to(sp[:, None, :], pp.shape)], axis=-1)
+        scores = jnp.tanh(
+            jnp.einsum("bsd,dk->bsk", cat, w_att_score))[..., 0]  # [B,Ts]
+        scores = jnp.where(src_mask > 0, scores, -1e9)
+        w = jax.nn.softmax(scores, axis=-1) * src_mask
+        w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-9)
+        return jnp.einsum("bs,bsh->bh", w, ep)            # [B,He]
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        x_t, t = inp
+        context = attention(h_prev)
+        dec_in = jnp.concatenate([h_prev, context, x_t], axis=-1)
+        gates = _mm(dec_in, w_step) + b_step
+        i_g, f_g, c_g, o_g = jnp.split(gates, 4, axis=-1)
+        i, f, o = (jax.nn.sigmoid(i_g), jax.nn.sigmoid(f_g),
+                   jax.nn.sigmoid(o_g))
+        c_new = f * c_prev + i * jnp.tanh(c_g)
+        h_new = o * jnp.tanh(c_new)
+        mask = (t < tgt_len)[:, None].astype(tp.dtype)
+        h_new = mask * h_new + (1 - mask) * h_prev
+        c_new = mask * c_new + (1 - mask) * c_prev
+        logits = _mm(h_new, w_out) + b_out
+        probs = jax.nn.softmax(logits, axis=-1)
+        return (h_new, c_new), probs
+
+    (_, _), ps = lax.scan(step, (h0, c0), (xs, ts))       # [Tt,B,V]
+    pred = jnp.swapaxes(ps, 0, 1)                         # [B,Tt,V]
+    return out(Out=padded_to_seq(pred, tgt_len, temb.ntokens))

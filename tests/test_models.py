@@ -1,0 +1,116 @@
+"""Model zoo smoke tests: each benchmark model builds, trains a step, and
+produces a finite decreasing-capable loss.
+
+Reference: benchmark/fluid/models/* driven by fluid_benchmark.py (SURVEY.md
+§6 parity workloads). Tiny batches keep CPU-compile times testable.
+"""
+
+import argparse
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.models as models
+
+
+def run_model(name, batch_size=4, iters=2, data_set="cifar10"):
+    import sys
+    sys.path.insert(0, "benchmark")
+    import importlib
+    fb = importlib.import_module("fluid_benchmark")
+
+    args = argparse.Namespace(
+        model=name, batch_size=batch_size, learning_rate=1e-3,
+        iterations=iters, pass_num=1, device="CPU", data_set=data_set,
+        infer_only=False, use_fake_data=False, profile=False,
+        parallel=False, skip_batch_num=1)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        avg_cost, infer_prog, optimizer, train_reader, test_reader, \
+            batch_acc = models.get_model(name)(args)
+        optimizer.minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for i, batch in enumerate(train_reader()):
+        if i >= iters or len(batch) < batch_size:
+            break
+        feed = fb.feed_dict_from_batch(batch, name)
+        out, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+        losses.append(float(np.asarray(out).mean()))
+    assert losses and all(np.isfinite(l) for l in losses), losses
+    return losses
+
+
+def test_mnist():
+    losses = run_model("mnist", batch_size=8, iters=3)
+    assert losses[0] < 10
+
+
+def test_resnet_cifar():
+    losses = run_model("resnet", batch_size=4, iters=2)
+    assert losses[0] < 20
+
+
+def test_stacked_dynamic_lstm():
+    losses = run_model("stacked_dynamic_lstm", batch_size=4, iters=2)
+    assert abs(losses[0] - np.log(2)) < 1.0
+
+
+def test_machine_translation():
+    losses = run_model("machine_translation", batch_size=4, iters=2)
+    # init loss ~= log(30000)
+    assert abs(losses[0] - np.log(30000)) < 2.0
+
+
+@pytest.mark.slow
+def test_vgg():
+    run_model("vgg", batch_size=2, iters=1)
+
+
+@pytest.mark.slow
+def test_se_resnext():
+    run_model("se_resnext", batch_size=2, iters=1)
+
+
+def test_reader_decorators():
+    r = fluid.reader.shuffle(fluid.dataset.mnist.train(), buf_size=64)
+    b = fluid.batch(r, batch_size=16)
+    batch = next(iter(b()))
+    assert len(batch) == 16
+    img, lbl = batch[0]
+    assert img.shape == (784,)
+    assert 0 <= lbl < 10
+
+    r2 = fluid.reader.firstn(fluid.dataset.mnist.train(), 5)
+    assert len(list(r2())) == 5
+
+    r3 = fluid.reader.map_readers(
+        lambda s: (s[0] * 2, s[1]), fluid.dataset.mnist.train())
+    img2, _ = next(iter(r3()))
+    np.testing.assert_allclose(img2, img * 0 + img2)  # shape check
+
+    r4 = fluid.reader.buffered(fluid.dataset.mnist.test(), 10)
+    assert len(list(r4())) == fluid.dataset.mnist.TEST_SIZE
+
+    r5 = fluid.reader.xmap_readers(
+        lambda s: (s[0] + 1, s[1]), fluid.dataset.mnist.test(), 2, 8)
+    assert len(list(r5())) == fluid.dataset.mnist.TEST_SIZE
+
+
+def test_datasets_deterministic():
+    a = list(fluid.reader.firstn(fluid.dataset.cifar.train10(), 3)())
+    b = list(fluid.reader.firstn(fluid.dataset.cifar.train10(), 3)())
+    for (xa, ya), (xb, yb) in zip(a, b):
+        np.testing.assert_array_equal(xa, xb)
+        assert ya == yb
+
+
+def test_wmt14_schema():
+    s = next(iter(fluid.dataset.wmt14.train(1000)()))
+    src, trg_in, trg_out = s
+    assert trg_in[0] == fluid.dataset.wmt14.START_ID
+    assert trg_out[-1] == fluid.dataset.wmt14.END_ID
+    assert len(trg_in) == len(trg_out)
