@@ -26,7 +26,7 @@ def parse_args():
     parser.add_argument("--iterations", type=int, default=50)
     parser.add_argument("--pass_num", type=int, default=1)
     parser.add_argument("--device", type=str, default="TPU",
-                        choices=["CPU", "TPU", "GPU"])
+                        choices=["CPU", "TPU"])
     parser.add_argument("--data_set", type=str, default="cifar10",
                         choices=["cifar10", "flowers", "imagenet"])
     parser.add_argument("--infer_only", action="store_true")
@@ -111,33 +111,53 @@ def train(args):
     is_seq = args.model in ("stacked_dynamic_lstm", "machine_translation")
     unit = "words/s" if is_seq else "images/s"
 
+    want = args.iterations + args.skip_batch_num
     batches = []
-    for i, batch in enumerate(train_reader()):
-        if len(batches) * args.batch_size >= \
-                (args.iterations + args.skip_batch_num) * args.batch_size:
+    for batch in train_reader():
+        if len(batches) >= want:
             break
         if len(batch) == args.batch_size:
             batches.append(batch)
+    if not batches:
+        raise ValueError(
+            f"no full batch of size {args.batch_size} available "
+            f"(reduce --batch_size)")
     if args.use_fake_data:
-        batches = [batches[0]] * (args.iterations + args.skip_batch_num)
+        batches = [batches[0]] * want
+
+    profiler_ctx = None
+    if args.profile:
+        import jax
+        jax.profiler.start_trace("/tmp/paddle_tpu_profile")
+        profiler_ctx = True
 
     count = 0.0
     elapsed = 0.0
     loss = None
-    for it, batch in enumerate(batches):
-        feed = feed_dict_from_batch(batch, args.model)
-        t0 = time.time()
-        outs = exe.run(main if not args.parallel else None,
-                       feed=feed, fetch_list=fetches) \
-            if not args.parallel else exe.run(feed=feed, fetch_list=fetches)
-        loss = float(np.asarray(outs[0]).mean())
-        dt = time.time() - t0
-        if it >= args.skip_batch_num:
-            elapsed += dt
-            count += tokens_in_batch(batch, args.model)
-        if it % 10 == 0:
-            print(f"iter {it} loss {loss:.4f} ({dt*1000:.1f} ms)",
-                  file=sys.stderr)
+    it = 0
+    for _pass in range(args.pass_num):
+        for batch in batches:
+            feed = feed_dict_from_batch(batch, args.model)
+            t0 = time.time()
+            if args.parallel:
+                outs = exe.run(fetches, feed=feed)
+            else:
+                outs = exe.run(main, feed=feed, fetch_list=fetches)
+            loss = float(np.asarray(outs[0]).mean())
+            dt = time.time() - t0
+            if it >= args.skip_batch_num:
+                elapsed += dt
+                count += tokens_in_batch(batch, args.model)
+            if it % 10 == 0:
+                print(f"pass {_pass} iter {it} loss {loss:.4f} "
+                      f"({dt*1000:.1f} ms)", file=sys.stderr)
+            it += 1
+
+    if profiler_ctx:
+        import jax
+        jax.profiler.stop_trace()
+        print("profile written to /tmp/paddle_tpu_profile", file=sys.stderr)
+
     throughput = count / max(elapsed, 1e-9)
     return {"metric": f"{args.model}_{unit}", "value": round(throughput, 2),
             "unit": unit, "loss": round(loss, 4)}

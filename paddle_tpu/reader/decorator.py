@@ -75,13 +75,16 @@ def compose(*readers, **kwargs):
     def reader():
         rs = [r() for r in readers]
         if check_alignment:
-            for outputs in zip(*rs):
-                yield sum(map(make_tuple, outputs), ())
-        else:
+            # raise when lengths differ (reference decorator.py: izip_longest
+            # + ComposeNotAligned when check_alignment=True)
             for outputs in itertools.zip_longest(*rs):
                 if any(o is None for o in outputs):
                     raise ComposeNotAligned(
                         "outputs of readers are not aligned")
+                yield sum(map(make_tuple, outputs), ())
+        else:
+            # silently truncate to the shortest reader
+            for outputs in zip(*rs):
                 yield sum(map(make_tuple, outputs), ())
 
     return reader

@@ -15,8 +15,10 @@ import paddle_tpu.models as models
 
 
 def run_model(name, batch_size=4, iters=2, data_set="cifar10"):
+    import os
     import sys
-    sys.path.insert(0, "benchmark")
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "benchmark"))
     import importlib
     fb = importlib.import_module("fluid_benchmark")
 
@@ -87,10 +89,12 @@ def test_reader_decorators():
     r2 = fluid.reader.firstn(fluid.dataset.mnist.train(), 5)
     assert len(list(r2())) == 5
 
+    first_img, first_lbl = next(iter(fluid.dataset.mnist.train()()))
     r3 = fluid.reader.map_readers(
         lambda s: (s[0] * 2, s[1]), fluid.dataset.mnist.train())
-    img2, _ = next(iter(r3()))
-    np.testing.assert_allclose(img2, img * 0 + img2)  # shape check
+    img2, lbl2 = next(iter(r3()))
+    np.testing.assert_allclose(img2, first_img * 2)
+    assert lbl2 == first_lbl
 
     r4 = fluid.reader.buffered(fluid.dataset.mnist.test(), 10)
     assert len(list(r4())) == fluid.dataset.mnist.TEST_SIZE
