@@ -125,38 +125,38 @@ def train(args):
     if args.use_fake_data:
         batches = [batches[0]] * want
 
-    profiler_ctx = None
     if args.profile:
         import jax
         jax.profiler.start_trace("/tmp/paddle_tpu_profile")
-        profiler_ctx = True
 
     count = 0.0
     elapsed = 0.0
     loss = None
     it = 0
-    for _pass in range(args.pass_num):
-        for batch in batches:
-            feed = feed_dict_from_batch(batch, args.model)
-            t0 = time.time()
-            if args.parallel:
-                outs = exe.run(fetches, feed=feed)
-            else:
-                outs = exe.run(main, feed=feed, fetch_list=fetches)
-            loss = float(np.asarray(outs[0]).mean())
-            dt = time.time() - t0
-            if it >= args.skip_batch_num:
-                elapsed += dt
-                count += tokens_in_batch(batch, args.model)
-            if it % 10 == 0:
-                print(f"pass {_pass} iter {it} loss {loss:.4f} "
-                      f"({dt*1000:.1f} ms)", file=sys.stderr)
-            it += 1
-
-    if profiler_ctx:
-        import jax
-        jax.profiler.stop_trace()
-        print("profile written to /tmp/paddle_tpu_profile", file=sys.stderr)
+    try:
+        for _pass in range(args.pass_num):
+            for batch in batches:
+                feed = feed_dict_from_batch(batch, args.model)
+                t0 = time.time()
+                if args.parallel:
+                    outs = exe.run(fetches, feed=feed)
+                else:
+                    outs = exe.run(main, feed=feed, fetch_list=fetches)
+                loss = float(np.asarray(outs[0]).mean())
+                dt = time.time() - t0
+                if it >= args.skip_batch_num:
+                    elapsed += dt
+                    count += tokens_in_batch(batch, args.model)
+                if it % 10 == 0:
+                    print(f"pass {_pass} iter {it} loss {loss:.4f} "
+                          f"({dt*1000:.1f} ms)", file=sys.stderr)
+                it += 1
+    finally:
+        if args.profile:
+            import jax
+            jax.profiler.stop_trace()
+            print("profile written to /tmp/paddle_tpu_profile",
+                  file=sys.stderr)
 
     throughput = count / max(elapsed, 1e-9)
     return {"metric": f"{args.model}_{unit}", "value": round(throughput, 2),

@@ -88,6 +88,7 @@ from .unique_name import generate as _generate_unique_name
 from . import unique_name
 from . import reader
 from . import dataset
+from . import parallel
 from .minibatch import batch
 
 Tensor = LoDTensor
@@ -107,5 +108,5 @@ __all__ = [
     "ParamAttr", "WeightNormParamAttr", "DataFeeder",
     "Trainer", "Inferencer", "transpiler", "DistributeTranspiler",
     "InferenceTranspiler", "memory_optimize", "release_memory",
-    "reader", "dataset", "batch", "unique_name",
+    "reader", "dataset", "batch", "unique_name", "parallel",
 ]

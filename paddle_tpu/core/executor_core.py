@@ -67,8 +67,14 @@ def run_ops(ops, env, ctx):
         ctx.current_op = op
         ctx.env = env
         ins = {}
+        # declaration-only inputs (e.g. listen_and_serv's recv buffers) are
+        # resolved lazily by the kernel itself
+        lazy = getattr(op_def, "lazy_inputs", False)
         for slot, names in op.inputs.items():
-            ins[slot] = [None if n == "" else env_get(env, n) for n in names]
+            ins[slot] = [
+                None if n == "" else env_get(env, n, allow_missing=lazy)
+                for n in names
+            ]
         try:
             outs = registry.run_kernel(op_def, ctx, ins, op.attrs) or {}
         except TraceUnsupported:

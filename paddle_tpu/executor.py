@@ -151,6 +151,33 @@ class Executor:
         return value
 
     # ------------------------------------------------------------------
+    def run_block_eager(self, block, scope):
+        """Run one block's ops eagerly against `scope` (reference
+        listen_and_serv_op.cc ParallelExecuteBlocks: nested
+        Executor::RunPreparedContext on a sub-block)."""
+        env = {}
+        for n in _block_touched_names(block):
+            v = scope.find_var(n)
+            if v is not None:
+                env[n] = (
+                    executor_core.feed_to_tracevalue(v)
+                    if isinstance(v, LoDTensor) else v
+                )
+        ctx = executor_core.OpContext(eager=True, scope=scope,
+                                      place=self.place)
+        executor_core.run_ops(block.ops, env, ctx)
+        # write back only durable vars (persistable, or already living in
+        # the scope) — block-local temporaries like grad.merged stay out
+        for op in block.ops:
+            for n in op.output_arg_names():
+                if n not in env:
+                    continue
+                var = block.vars.get(n) or block.program.global_block().vars.get(n)
+                if (var is not None and var.persistable) or \
+                        scope.find_var(n) is not None:
+                    scope.var(n)
+                    scope.set_var(n, env[n])
+
     def _run_eager(self, program, scope, feed, fetch_names):
         feed_vals = self._feed_values(program, feed)
         env = {}
@@ -189,3 +216,11 @@ class Executor:
         for n in fetch_names:
             outs.append(self._to_host(executor_core.env_get(env, n)))
         return outs
+
+
+def _block_touched_names(block):
+    names = set()
+    for op in block.ops:
+        names.update(op.input_arg_names())
+        names.update(op.output_arg_names())
+    return names

@@ -18,3 +18,4 @@ from . import io_ops
 from . import metric_ops
 from . import detection_ops
 from . import collective_ops
+from . import rpc_ops

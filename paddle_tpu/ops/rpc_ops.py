@@ -1,0 +1,167 @@
+"""Distributed RPC ops: send_vars / send_barrier / recv / fetch_barrier /
+send / listen_and_serv.
+
+Reference parity: operators/send_vars_op.cc, send_barrier_op.cc, recv_op.cc,
+fetch_barrier_op.cc, send_op.cc:29, listen_and_serv_op.{h:36,cc} (sync loop,
+ParallelExecuteBlocks:54, port save). Transport is the TCP variable runtime
+in parallel/rpc.py (the gRPC-runtime equivalent). All are host ops
+(no_trace): they run in the eager interpreter path, exactly like the
+reference where RPC ops run on the CPU control plane while dense math rides
+the device.
+"""
+
+import numpy as np
+
+from ..core.registry import register_op
+from ..parallel import rpc as rpc_runtime
+
+_client_cache = {}
+
+
+def _client(ep):
+    c = _client_cache.get(ep)
+    if c is None:
+        c = rpc_runtime.VariableClient(ep)
+        _client_cache[ep] = c
+    return c
+
+
+def reset_clients():
+    for c in _client_cache.values():
+        try:
+            c.shutdown()
+        except Exception:
+            pass
+    _client_cache.clear()
+
+
+def _resolve_value(ctx, name):
+    """env first (live trace values), then scope (persistables)."""
+    value = ctx.env.get(name) if getattr(ctx, "env", None) is not None else None
+    if value is None and ctx.scope is not None:
+        value = ctx.scope.find_var(name)
+    if value is None:
+        raise KeyError(f"send: variable {name!r} not found in env or scope")
+    return value
+
+
+@register_op("send_vars", no_trace=True, lod_aware=True)
+def send_vars_op(ctx, ins, attrs):
+    op = ctx.current_op
+    names = op.input("X")
+    epmap = attrs["epmap"]
+    for name, ep in zip(names, epmap):
+        _client(ep).send_var(name, _resolve_value(ctx, name))
+    return {}
+
+
+@register_op("send_barrier", no_trace=True)
+def send_barrier_op(ctx, ins, attrs):
+    for ep in attrs["endpoints"]:
+        _client(ep).batch_barrier()
+    return {}
+
+
+@register_op("recv", no_trace=True, lod_aware=True)
+def recv_op(ctx, ins, attrs):
+    op = ctx.current_op
+    names = op.output("Out")
+    epmap = attrs["epmap"]
+    result = {}
+    for name, ep in zip(names, epmap):
+        result.setdefault("Out", []).append(_client(ep).get_var(name))
+    return result
+
+
+@register_op("fetch_barrier", no_trace=True)
+def fetch_barrier_op(ctx, ins, attrs):
+    for ep in attrs["endpoints"]:
+        _client(ep).fetch_barrier()
+    return {}
+
+
+@register_op("send", no_trace=True, lod_aware=True)
+def send_op(ctx, ins, attrs):
+    """combined send grads + barrier + fetch params (reference send_op.cc:29,
+    used by layers.Send)."""
+    op = ctx.current_op
+    names = op.input("X")
+    epmap = attrs["epmap"]
+    for name, ep in zip(names, epmap):
+        _client(ep).send_var(name, _resolve_value(ctx, name))
+    for ep in sorted(set(epmap)):
+        _client(ep).batch_barrier()
+    out_names = op.output("Out")
+    result = {}
+    if out_names:
+        for name, ep in zip(out_names, epmap):
+            result.setdefault("Out", []).append(_client(ep).get_var(name))
+    for ep in sorted(set(epmap)):
+        _client(ep).fetch_barrier()
+    return result
+
+
+@register_op("listen_and_serv", no_trace=True, lod_aware=True)
+def listen_and_serv_op(ctx, ins, attrs):
+    """Blocking pserver service (reference listen_and_serv_op.cc): receive
+    grad shards from Fanin trainers, run per-param optimize sub-blocks, serve
+    updated params; loops until a client sends exit."""
+    from ..executor import Executor
+    from ..core.places import CPUPlace
+
+    op = ctx.current_op
+    scope = ctx.scope
+    endpoint = attrs["endpoint"]
+    fan_in = int(attrs.get("Fanin", 1))
+    sync_mode = attrs.get("sync_mode", True)
+    opt_blocks = attrs.get("OptimizeBlocks") or (
+        [attrs["OptimizeBlock"]] if attrs.get("OptimizeBlock") else [])
+
+    exe = Executor(CPUPlace())
+
+    def get_var(name):
+        v = scope.find_var(name)
+        if v is None:
+            raise KeyError(name)
+        return v
+
+    def put_var(name, value):
+        scope.var(name)
+        scope.set_var(name, value)
+
+    def on_round(received):
+        # run each param shard's optimize block (reference
+        # ParallelExecuteBlocks; sequential here — XLA owns math threads)
+        for block in opt_blocks:
+            exe.run_block_eager(block, scope)
+
+    # async mode: per-grad optimize block (reference async_update.md;
+    # grad_to_block_id maps each grad var to its optimize block)
+    grad_to_block = {}
+    for entry in attrs.get("grad_to_block_id", []):
+        gname, bidx = entry.rsplit(":", 1)
+        for b in opt_blocks:
+            if getattr(b, "idx", None) == int(bidx):
+                grad_to_block[gname] = b
+
+    def on_grad(name):
+        block = grad_to_block.get(name)
+        if block is not None:
+            exe.run_block_eager(block, scope)
+
+    host = endpoint.rsplit(":", 1)[0] if ":" in endpoint else "127.0.0.1"
+    port = endpoint.rsplit(":", 1)[1] if ":" in endpoint else "0"
+    server = rpc_runtime.VariableServer(
+        bind=f"{host}:{port}", num_trainers=fan_in, get_var=get_var,
+        put_var=put_var, on_round=on_round, sync_mode=sync_mode,
+        on_grad=on_grad)
+    server.save_port()
+    server.serve_forever()
+    return {}
+
+
+# listen_and_serv's X inputs are recv-buffer declarations that only
+# materialize when trainers send grads — resolve them lazily
+from ..core import registry as _registry  # noqa: E402
+
+_registry.get_op_def("listen_and_serv").lazy_inputs = True

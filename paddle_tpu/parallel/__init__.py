@@ -1,0 +1,22 @@
+"""Parallelism package: mesh management, multi-host bootstrap, the RPC
+variable runtime (pserver transport), and sequence-parallel ring attention.
+
+Reference mapping (SURVEY.md §2.4):
+  NCCL collectives      -> mesh + XLA collectives (mesh.py; pjit shardings)
+  gen_nccl_id bootstrap -> distributed.py (jax.distributed over DCN)
+  gRPC send/recv        -> rpc.py (TCP variable transport) + ops/rpc_ops.py
+  (absent in reference) -> ring_attention.py sequence/context parallelism
+"""
+
+from . import mesh
+from . import distributed
+from . import rpc
+from . import ring
+from .mesh import make_mesh, data_parallel_mesh, mesh_scope
+from .ring import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "mesh", "distributed", "rpc", "ring",
+    "make_mesh", "data_parallel_mesh", "mesh_scope",
+    "ring_attention", "ring_attention_sharded",
+]

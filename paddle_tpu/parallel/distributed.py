@@ -1,0 +1,78 @@
+"""Multi-host distributed bootstrap.
+
+Reference parity: the "NCCL2 mode" bootstrap — gen_nccl_id_op.cc:31 serves an
+ncclUniqueId from trainer 0 over gRPC, then every trainer constructs
+NCCLContextMap(nccl_id, num_trainers, trainer_id) (nccl_helper.h:92-118);
+drivers read PADDLE_* env vars (trainer.py:148-196, fluid_benchmark.py:111).
+
+TPU-native: jax.distributed.initialize(coordinator, num_processes,
+process_id) plays the gen_nccl_id role (rank-0 coordinator, everyone else
+dials in over DCN), after which jax.devices() spans all hosts and a mesh
+built from them shards programs globally — XLA routes intra-slice collective
+traffic over ICI and cross-slice over DCN.
+"""
+
+import os
+
+import jax
+
+__all__ = ["init_from_env", "initialize", "is_initialized", "ClusterEnv"]
+
+_initialized = [False]
+
+
+class ClusterEnv:
+    """Parsed PADDLE_* environment (reference trainer.py:148-196)."""
+
+    def __init__(self, env=None):
+        e = env or os.environ
+        self.training_role = e.get("PADDLE_TRAINING_ROLE", "TRAINER")
+        self.trainer_id = int(e.get("PADDLE_TRAINER_ID", "0"))
+        self.num_trainers = int(e.get("PADDLE_TRAINERS", "1"))
+        # collective (nccl2-mode) bootstrap endpoint: rank 0's address
+        self.coordinator = e.get(
+            "PADDLE_COORDINATOR",
+            e.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:7777"))
+        # pserver mode
+        self.pserver_endpoints = [
+            p for p in e.get("PSERVERS", e.get("PADDLE_PSERVERS", "")).split(",")
+            if p
+        ]
+        self.current_endpoint = e.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def is_pserver(self):
+        return self.training_role == "PSERVER"
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None,
+               local_device_ids=None):
+    """jax.distributed.initialize wrapper; safe to call once per process."""
+    if _initialized[0]:
+        return
+    if num_processes is None or num_processes <= 1:
+        _initialized[0] = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized[0] = True
+
+
+def init_from_env():
+    """Bootstrap multi-host from PADDLE_* env vars; returns ClusterEnv."""
+    env = ClusterEnv()
+    if env.num_trainers > 1 and not env.is_pserver:
+        initialize(
+            coordinator_address=env.coordinator,
+            num_processes=env.num_trainers,
+            process_id=env.trainer_id,
+        )
+    return env
+
+
+def is_initialized():
+    return _initialized[0]

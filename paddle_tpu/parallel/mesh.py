@@ -1,0 +1,72 @@
+"""Device-mesh management: named-axis meshes for dp/mp/pp/sp.
+
+Reference contrast: the reference enumerates CUDA places and builds
+NCCLContextMap per device set (platform/nccl_helper.h:75). On TPU the mesh
+IS the communicator: axes are named, shardings reference axis names, and XLA
+emits ICI collectives for any cross-shard dataflow (SURVEY.md §2.4).
+"""
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "data_parallel_mesh", "current_mesh", "mesh_scope",
+           "DP_AXIS", "MP_AXIS", "PP_AXIS", "SP_AXIS"]
+
+DP_AXIS = "dp"   # data parallel (batch)
+MP_AXIS = "mp"   # tensor/model parallel
+PP_AXIS = "pp"   # pipeline stages
+SP_AXIS = "sp"   # sequence/context parallel
+
+_current = [None]
+
+
+def make_mesh(shape=None, axis_names=None, devices=None):
+    """Build a Mesh. shape: dict axis->size or tuple; default: all devices
+    on the dp axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        return Mesh(np.array(devices), (DP_AXIS,))
+    if isinstance(shape, dict):
+        axis_names = tuple(shape.keys())
+        dims = tuple(shape.values())
+    else:
+        dims = tuple(shape)
+        axis_names = tuple(axis_names or
+                           (DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS)[: len(dims)])
+    n = int(np.prod(dims))
+    if n != len(devices):
+        raise ValueError(f"mesh shape {dims} needs {n} devices, "
+                         f"have {len(devices)}")
+    return Mesh(np.array(devices).reshape(dims), axis_names)
+
+
+def data_parallel_mesh(num_devices=None):
+    devs = jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (DP_AXIS,))
+
+
+def current_mesh():
+    return _current[0]
+
+
+class mesh_scope:
+    """with mesh_scope(mesh): ... — sets the ambient mesh (used by
+    ParallelExecutor and shard_map-based ops)."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        self._prev = _current[0]
+        _current[0] = self.mesh
+        self._ctx = self.mesh
+        self._ctx.__enter__()
+        return self.mesh
+
+    def __exit__(self, *exc):
+        self._ctx.__exit__(*exc)
+        _current[0] = self._prev
+        return False

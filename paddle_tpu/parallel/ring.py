@@ -1,0 +1,106 @@
+"""Ring attention: exact attention over sequences sharded across the mesh.
+
+The reference has NO sequence parallelism (SURVEY.md §5 long-context: LoD
+bucketing only); this is the TPU build's first-class long-context capability.
+
+Algorithm (blockwise-stable ring): each device holds one sequence shard of
+Q, K, V. K/V blocks rotate around the ring via lax.ppermute; each hop every
+device accumulates its Q-block's attention against the visiting K/V block
+with the numerically-stable streaming-softmax update (running max m and
+normalizer l), so the result is EXACT full attention with O(S/n) memory per
+chip and compute/communication overlapped hop by hop over ICI.
+
+Usage: inside shard_map over a mesh with a sequence axis, or via
+ring_attention() which wraps the shard_map. Causal masking uses global
+position offsets per shard.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention_sharded", "ring_attention"]
+
+
+def _block_attn(q, k, v, bias, m_prev, l_prev, o_prev, scale):
+    """One streaming-softmax accumulation step.
+    q: [B,H,Sq,D]; k,v: [B,H,Sk,D]; bias: [B,H,Sq,Sk] additive (-inf mask).
+    Returns updated (m, l, o)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    m_cur = jnp.max(s, axis=-1)                        # [B,H,Sq]
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked rows (all -inf)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])                 # [B,H,Sq,Sk]
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l_cur = jnp.sum(p, axis=-1)                        # [B,H,Sq]
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+    l_new = alpha * l_prev + l_cur
+    o_new = alpha[..., None] * o_prev + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(p.dtype),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, o_new
+
+
+def ring_attention_sharded(q, k, v, axis_name, causal=False, scale=None):
+    """Per-shard body: call inside shard_map/pmap over `axis_name`.
+
+    q,k,v: [B, H, S_local, D] — this device's sequence shard.
+    Returns [B, H, S_local, D] exact attention output."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+
+    qf = q.astype(jnp.float32)
+    m = jnp.full((B, H, S), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, S), jnp.float32)
+    o = jnp.zeros((B, H, S, D), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(carry, hop_idx):
+        k_blk, v_blk, m, l, o = carry
+        # global block index the visiting K/V block came from
+        src = (idx - hop_idx) % n
+        if causal:
+            q_pos = idx * S + jnp.arange(S)            # [S]
+            k_pos = src * S + jnp.arange(S)            # [S]
+            mask = q_pos[:, None] >= k_pos[None, :]    # [S,S]
+            bias = jnp.where(mask, 0.0, -jnp.inf)[None, None]
+        else:
+            bias = None
+        m, l, o = _block_attn(qf, k_blk.astype(jnp.float32),
+                              v_blk.astype(jnp.float32), bias, m, l, o,
+                              scale)
+        # rotate K/V to the next device (overlaps with next hop's compute)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m, l, o), None
+
+    (k_fin, v_fin, m, l, o), _ = lax.scan(
+        hop, (k, v, m, l, o), jnp.arange(n))
+    l_safe = jnp.maximum(l, 1e-20)
+    return (o / l_safe[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
+    """Full-tensor entry: q,k,v [B,H,S,D] sharded (or shardable) on S over
+    mesh axis `axis_name`. Returns attention output with the same sharding.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(ring_attention_sharded, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)

@@ -1,0 +1,269 @@
+"""Variable-transport RPC runtime: the gRPC-runtime equivalent.
+
+Reference parity: paddle/fluid/operators/detail/ (grpc_client.h:164
+AsyncSendVariable/AsyncGetVariable + batch/fetch barriers :136-160,
+grpc_server.h:47 AsyncGRPCServer, protocol send_recv.proto:17
+SendVariable/GetVariable) and listen_and_serv_op.cc's sync update loop.
+
+Transport: length-prefixed pickled messages over TCP sockets (the reference's
+legacy LightNetwork.h:40 style, with send_recv.proto's message surface).
+Variables serialize as (numpy bytes, dtype, shape, lod). The server mirrors
+RunSyncUpdate: collect grads from all trainers -> barrier -> run per-param
+optimize blocks -> serve params until fetch barrier.
+
+Port discovery: server writes /tmp/paddle.<pid>.port once bound (reference
+listen_and_serv_op.cc SavePort), so tests can fork a pserver and find it.
+"""
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["VariableClient", "VariableServer", "serialize_var",
+           "deserialize_var"]
+
+_MAGIC = b"PTRV"
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(_MAGIC + struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    hdr = _recv_exact(sock, 12)
+    if hdr[:4] != _MAGIC:
+        raise ConnectionError("bad frame magic")
+    (ln,) = struct.unpack(">Q", hdr[4:])
+    return pickle.loads(_recv_exact(sock, ln))
+
+
+def serialize_var(value):
+    """LoDTensor / numpy / jax array -> wire dict (send_recv.proto
+    VariableMessage: dims, lod, serialized bytes)."""
+    from ..core.lod_tensor import LoDTensor
+
+    if isinstance(value, LoDTensor):
+        arr = np.asarray(value.numpy())
+        return {"kind": "lod_tensor", "data": arr.tobytes(),
+                "dtype": str(arr.dtype), "shape": arr.shape,
+                "lod": value.lod()}
+    arr = np.asarray(value)
+    return {"kind": "tensor", "data": arr.tobytes(),
+            "dtype": str(arr.dtype), "shape": arr.shape, "lod": []}
+
+
+def deserialize_var(msg):
+    from ..core.lod_tensor import LoDTensor
+
+    arr = np.frombuffer(
+        msg["data"], dtype=np.dtype(msg["dtype"])).reshape(msg["shape"])
+    if msg["kind"] == "lod_tensor" and msg["lod"]:
+        return LoDTensor(arr.copy(), msg["lod"])
+    return arr.copy()
+
+
+class VariableClient:
+    """Per-endpoint connection (reference RPCClient, grpc_client.h:164)."""
+
+    def __init__(self, endpoint, connect_timeout=60.0):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=connect_timeout)
+        # blocking thereafter: a sync-mode get legitimately waits for the
+        # slowest trainer's round (e.g. first-step XLA compile can exceed
+        # any fixed timeout)
+        self._sock.settimeout(None)
+
+    def send_var(self, name, value):
+        _send_msg(self._sock, ("send", name, serialize_var(value)))
+        resp = _recv_msg(self._sock)
+        assert resp == ("ok",), resp
+
+    def get_var(self, name):
+        _send_msg(self._sock, ("get", name))
+        tag, payload = _recv_msg(self._sock)
+        if tag == "err":
+            raise KeyError(payload)
+        return deserialize_var(payload)
+
+    def batch_barrier(self):
+        """reference BATCH_BARRIER_MESSAGE after grads sent."""
+        _send_msg(self._sock, ("batch_barrier",))
+        assert _recv_msg(self._sock) == ("ok",)
+
+    def fetch_barrier(self):
+        """reference FETCH_BARRIER_MESSAGE after params fetched."""
+        _send_msg(self._sock, ("fetch_barrier",))
+        assert _recv_msg(self._sock) == ("ok",)
+
+    def shutdown(self):
+        try:
+            _send_msg(self._sock, ("exit",))
+        except OSError:
+            pass
+        self._sock.close()
+
+
+class VariableServer:
+    """Sync-update variable server (reference AsyncGRPCServer +
+    listen_and_serv_op RunSyncLoop).
+
+    on_round(recv_names) is invoked once all `num_trainers` batch barriers
+    arrive; it should run the optimize blocks against the owning scope. Gets
+    are served only between on_round completion and the fetch barriers
+    (sync semantics)."""
+
+    def __init__(self, bind="127.0.0.1:0", num_trainers=1, get_var=None,
+                 put_var=None, on_round=None, sync_mode=True, on_grad=None):
+        host, port = bind.rsplit(":", 1)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self.num_trainers = num_trainers
+        self.sync_mode = sync_mode
+        self._get_var = get_var
+        self._put_var = put_var
+        self._on_round = on_round
+        self._on_grad = on_grad  # async mode: per-grad update callback
+        self._lock = threading.Condition()
+        self._batch_count = 0
+        self._fetch_count = 0
+        self._round_done = not sync_mode
+        self._received = []
+        self._stop = False
+        self._threads = []
+
+    def save_port(self, path=None):
+        path = path or f"/tmp/paddle.{os.getpid()}.port"
+        with open(path, "w") as f:
+            f.write(str(self.port))
+        return path
+
+    # ------------------------------------------------------------------
+    def serve_forever(self):
+        """Accept loop; one thread per connection (reference grpc_server
+        thread pools)."""
+        accept_thread = threading.Thread(target=self._accept_loop,
+                                         daemon=True)
+        accept_thread.start()
+        with self._lock:
+            while not self._stop:
+                self._lock.wait(0.1)
+        self._listener.close()
+
+    def start(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def stop(self):
+        with self._lock:
+            self._stop = True
+            self._lock.notify_all()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                self._listener.settimeout(0.2)
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # ------------------------------------------------------------------
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                op = msg[0]
+                if op == "send":
+                    _, name, payload = msg
+                    value = deserialize_var(payload)
+                    with self._lock:
+                        self._received.append(name)
+                    if self._put_var:
+                        self._put_var(name, value)
+                    if not self.sync_mode and self._on_grad:
+                        # async update (reference async_update.md design):
+                        # run this grad's optimize block immediately
+                        with self._lock:
+                            self._on_grad(name)
+                    _send_msg(conn, ("ok",))
+                elif op == "batch_barrier":
+                    self._handle_batch_barrier()
+                    _send_msg(conn, ("ok",))
+                elif op == "get":
+                    _, name = msg
+                    with self._lock:
+                        while self.sync_mode and not self._round_done \
+                                and not self._stop:
+                            self._lock.wait(0.1)
+                    try:
+                        value = self._get_var(name)
+                        _send_msg(conn, ("var", serialize_var(value)))
+                    except KeyError as e:
+                        _send_msg(conn, ("err", str(e)))
+                elif op == "fetch_barrier":
+                    self._handle_fetch_barrier()
+                    _send_msg(conn, ("ok",))
+                elif op == "exit":
+                    self.stop()
+                    return
+        except (ConnectionError, EOFError, OSError):
+            return
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            try:
+                _send_msg(conn, ("err", "server error; see pserver log"))
+            except OSError:
+                pass
+            return
+
+    def _handle_batch_barrier(self):
+        with self._lock:
+            self._batch_count += 1
+            if self._batch_count >= self.num_trainers:
+                received, self._received = self._received, []
+                self._batch_count = 0
+                if self._on_round:
+                    self._on_round(received)
+                self._round_done = True
+                self._lock.notify_all()
+            else:
+                while self._batch_count != 0 and not self._stop:
+                    self._lock.wait(0.1)
+
+    def _handle_fetch_barrier(self):
+        with self._lock:
+            self._fetch_count += 1
+            if self._fetch_count >= self.num_trainers:
+                self._fetch_count = 0
+                if self.sync_mode:
+                    self._round_done = False
+                self._lock.notify_all()
+            else:
+                while self._fetch_count != 0 and not self._stop:
+                    self._lock.wait(0.1)

@@ -357,13 +357,22 @@ class DistributeTranspiler:
                 same_or_split_var(var_name, p.split(".block")[0]) for p in param_names
             )
 
+        # vars the pserver program actually uses (params + optimizer aux
+        # vars cloned by _append_pserver_optimize_op: learning rate,
+        # accumulators) — their init ops must run on this pserver too
+        pserver_vars = set()
+        for blk in pserver_program.blocks:
+            pserver_vars.update(blk.vars.keys())
+
         created = set()
         for op in orig_s_prog.global_block().ops:
             out_names = op.output_arg_names()
             if not out_names:
                 continue
             target = out_names[0]
-            if any(same_or_split_var(p, target) or p == target for p in param_names) or any(
+            if target in pserver_vars or any(
+                same_or_split_var(p, target) or p == target for p in param_names
+            ) or any(
                 target == p.split(".block")[0] for p in param_names
             ):
                 orig_var = orig_s_prog.global_block().vars.get(target)

@@ -1,0 +1,205 @@
+"""Distributed training without a real cluster.
+
+Reference: unittests/test_dist_train.py — fork a pserver with
+multiprocessing, discover its port, run a trainer in-process against
+127.0.0.1, compare with local output (SURVEY.md §4.6). Also the transpiler
+program-text test (test_dist_transpiler.py pattern) and raw RPC runtime
+round trip.
+"""
+
+import multiprocessing
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program, program_guard
+from paddle_tpu.parallel import rpc
+
+
+def test_rpc_variable_roundtrip():
+    """Raw client/server variable send/get + barriers (reference
+    operators/detail/grpc_server_test.cc in-proc pattern)."""
+    store = {}
+    rounds = []
+    server = rpc.VariableServer(
+        num_trainers=1,
+        get_var=lambda n: store[n],
+        put_var=store.__setitem__,
+        on_round=rounds.append,
+    )
+    server.start()
+    try:
+        c = rpc.VariableClient(f"127.0.0.1:{server.port}")
+        x = np.arange(12, dtype="float32").reshape(3, 4)
+        c.send_var("w@GRAD", x)
+        c.batch_barrier()
+        assert rounds and rounds[0] == ["w@GRAD"]
+        store["w"] = x * 2
+        got = c.get_var("w")
+        np.testing.assert_array_equal(got, x * 2)
+        c.fetch_barrier()
+        # lod tensor round trip
+        lt = fluid.create_lod_tensor(
+            np.arange(6, dtype="int64").reshape(6, 1), [[4, 2]],
+            fluid.CPUPlace())
+        c.send_var("seq", lt)
+        back = store["seq"]
+        assert back.lod() == [[0, 4, 6]] or back.lod() == [[4, 2]], back.lod()
+        c.shutdown()
+    finally:
+        server.stop()
+
+
+def _build_trainer_style_program():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=2, bias_attr=False,
+                        param_attr=fluid.ParamAttr(name="W"))
+    loss = fluid.layers.mean(y)
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    return loss
+
+
+def test_dist_transpiler_program_text():
+    """Transpiled trainer program has send/recv ops and no optimize ops;
+    pserver program has listen_and_serv with optimize sub-blocks
+    (reference test_dist_transpiler.py asserts on rewritten op lists)."""
+    pservers = "127.0.0.1:6174,127.0.0.1:6175"
+    with program_guard(Program(), Program()):
+        _build_trainer_style_program()
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, pservers=pservers, trainers=1)
+        trainer_prog = t.get_trainer_program()
+        ptypes = [op.type for op in trainer_prog.global_block().ops]
+        assert "send_vars" in ptypes
+        assert "send_barrier" in ptypes
+        assert "recv" in ptypes
+        assert "fetch_barrier" in ptypes
+        assert "sgd" not in ptypes
+
+        pserver_prog = t.get_pserver_program("127.0.0.1:6174")
+        stypes = [op.type for op in pserver_prog.global_block().ops]
+        assert "listen_and_serv" in stypes
+        ls_op = [op for op in pserver_prog.global_block().ops
+                 if op.type == "listen_and_serv"][0]
+        blocks = ls_op.attrs["OptimizeBlocks"]
+        assert blocks, "pserver program lost its optimize sub-blocks"
+        sub_types = [op.type for b in blocks for op in b.ops]
+        assert "sgd" in sub_types
+
+        startup = t.get_startup_program("127.0.0.1:6174", pserver_prog)
+        assert startup is not None
+
+
+def _pserver_main(port_queue):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as fluid
+    from paddle_tpu.core.framework import Program, program_guard
+
+    with program_guard(Program(), Program()):
+        _build_trainer_style_program()
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, pservers="127.0.0.1:0", trainers=1)
+        pserver_prog = t.get_pserver_program("127.0.0.1:0")
+        startup = t.get_startup_program("127.0.0.1:0", pserver_prog)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+
+        # patch: run listen_and_serv manually so we can report the port
+        from paddle_tpu.parallel import rpc as rpc_runtime
+        from paddle_tpu.core import registry
+
+        # reuse the kernel but capture the server to get its bound port:
+        # easiest path — run the op with endpoint 127.0.0.1:0 and read the
+        # port file it writes
+        import threading
+
+        def run():
+            exe.run(pserver_prog)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        # wait for the port file
+        port_file = f"/tmp/paddle.{os.getpid()}.port"
+        for _ in range(200):
+            if os.path.exists(port_file):
+                with open(port_file) as f:
+                    port_queue.put(int(f.read()))
+                break
+            time.sleep(0.05)
+        else:
+            port_queue.put(-1)
+        th.join(timeout=60)
+
+
+@pytest.mark.slow
+def test_dist_train_pserver_roundtrip():
+    """Full pserver flow: forked pserver process + in-process trainer."""
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    proc = ctx.Process(target=_pserver_main, args=(q,), daemon=True)
+    proc.start()
+    try:
+        port = q.get(timeout=120)
+        assert port > 0, "pserver failed to bind"
+        endpoint = f"127.0.0.1:{port}"
+
+        with program_guard(Program(), Program()):
+            loss = _build_trainer_style_program()
+            t = fluid.DistributeTranspiler()
+            t.transpile(trainer_id=0, pservers=endpoint, trainers=1)
+            trainer_prog = t.get_trainer_program()
+
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            w0 = np.array(fluid.executor.fetch_var("W"))
+            xv = np.ones((4, 4), dtype="float32")
+            out, = exe.run(trainer_prog, feed={"x": xv}, fetch_list=[loss])
+            w1 = np.array(fluid.executor.fetch_var("W"))
+        # pserver applied W' = W - 0.1 * dL/dW; dL/dW = mean over batch
+        # of x outer: = 0.5 for each element (mean of y over 2 outputs)
+        assert np.isfinite(float(np.asarray(out).item()))
+        assert not np.allclose(w0, w1), "param not updated via pserver"
+    finally:
+        from paddle_tpu.parallel.rpc import VariableClient
+        try:
+            VariableClient(endpoint).shutdown()
+        except Exception:
+            pass
+        proc.join(timeout=10)
+        if proc.is_alive():
+            proc.terminate()
+
+
+def test_async_mode_updates_without_barriers():
+    """async pserver (reference async_update.md): each grad send triggers
+    its optimize block immediately; no barriers involved."""
+    store = {"W": np.ones((4, 2), dtype="float32")}
+    updates = []
+
+    def on_grad(name):
+        # emulate the per-grad optimize block
+        store["W"] = store["W"] - 0.1 * store[name]
+        updates.append(name)
+
+    server = rpc.VariableServer(
+        num_trainers=1, sync_mode=False,
+        get_var=lambda n: store[n], put_var=store.__setitem__,
+        on_grad=on_grad)
+    server.start()
+    try:
+        c = rpc.VariableClient(f"127.0.0.1:{server.port}")
+        g = np.full((4, 2), 2.0, dtype="float32")
+        c.send_var("W@GRAD", g)
+        # async: get served immediately, update already applied
+        w = c.get_var("W")
+        np.testing.assert_allclose(w, np.ones((4, 2)) - 0.2)
+        assert updates == ["W@GRAD"]
+        c.shutdown()
+    finally:
+        server.stop()
