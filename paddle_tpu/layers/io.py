@@ -250,10 +250,13 @@ def read_file(file_obj):
     if meta:
         for shape, dtype, lod in zip(meta["shapes"], meta["dtypes"], meta["lod_levels"]):
             outs.append(
-                helper.create_tmp_variable(dtype=dtype, shape=tuple(shape), lod_level=lod)
+                helper.create_tmp_variable(
+                    dtype=dtype, shape=tuple(shape), lod_level=lod,
+                    stop_gradient=True)
             )
     else:
-        outs.append(helper.create_tmp_variable(dtype="float32"))
+        outs.append(
+            helper.create_tmp_variable(dtype="float32", stop_gradient=True))
     helper.append_op("read", {"Reader": [file_obj]}, {"Out": outs})
     if len(outs) == 1:
         return outs[0]

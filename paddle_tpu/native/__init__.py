@@ -1,0 +1,6 @@
+"""Native (C++) components, ctypes-bound: recordio (more to come:
+allocator, data-loader core)."""
+
+from . import build
+
+__all__ = ["build"]

@@ -1,0 +1,36 @@
+"""Build the native libraries on demand (g++; cached by source mtime).
+
+Reference contrast: the reference's cmake tree builds libpaddle_fluid; this
+build keeps native components small, each a standalone .so with a C ABI
+bound via ctypes (pybind11 is not available in this environment).
+"""
+
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_library(name, sources, extra_flags=()):
+    """Compile sources into lib<name>.so next to this file; returns path.
+    Rebuilds only when a source is newer than the binary."""
+    out = os.path.join(_HERE, f"lib{name}.so")
+    srcs = [os.path.join(_HERE, s) for s in sources]
+    if os.path.exists(out) and all(
+            os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs):
+        return out
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", out,
+           *srcs, *extra_flags]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"native build failed: {' '.join(cmd)}\n{e.stderr}") from e
+    except FileNotFoundError:
+        raise RuntimeError("g++ not found; native components unavailable")
+    return out
+
+
+def recordio_lib():
+    return build_library("recordio", ["recordio.cc"], ["-lz"])

@@ -19,3 +19,4 @@ from . import metric_ops
 from . import detection_ops
 from . import collective_ops
 from . import rpc_ops
+from . import reader_ops

@@ -1,0 +1,330 @@
+"""Reader-as-variable ops: file readers + decorator readers + read.
+
+Reference parity: paddle/fluid/operators/reader/ (~1810 LoC):
+create_recordio_file_reader_op.cc, open_files_op.cc,
+create_shuffle_reader_op.cc, create_batch_reader_op.cc,
+create_double_buffer_reader_op.cc:34-69 (prefetch thread + blocking queue),
+create_multi_pass_reader_op.cc, create_random_data_generator_op.cc,
+read_op.cc, reader framework framework/reader.h (ReaderBase /
+DecoratedReader chain).
+
+Readers are host objects living in the Scope (the eager path), exactly like
+the reference's Variables holding ReaderHolder. Samples are lists of
+(numpy array, lod-or-None) per slot; `read` pops one batch into tensors.
+"""
+
+import pickle
+import random
+import threading
+from queue import Queue
+
+import numpy as np
+
+from ..core.registry import register_op, SeqTensor
+from ..core import registry as _registry
+from .util import out
+
+import jax.numpy as jnp
+
+
+class ReaderBase:
+    """reference framework/reader.h ReaderBase."""
+
+    def read_next(self):
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+
+class RecordIOFileReader(ReaderBase):
+    def __init__(self, filename, pass_num=1):
+        from .. import recordio
+
+        self._scanner = recordio.Scanner(filename)
+        self._pass_num = pass_num
+        self._cur_pass = 0
+        self._it = iter(self._scanner)
+
+    def read_next(self):
+        while True:
+            rec = next(self._it, None)
+            if rec is not None:
+                return pickle.loads(rec)
+            self._cur_pass += 1
+            if self._cur_pass >= self._pass_num:
+                return None
+            self._scanner.reset()
+            self._it = iter(self._scanner)
+
+    def reset(self):
+        self._cur_pass = 0
+        self._scanner.reset()
+        self._it = iter(self._scanner)
+
+
+class MultiFileReader(ReaderBase):
+    """open_files: round-robin over per-file readers (reference
+    open_files_op.cc with thread_num prefetchers)."""
+
+    def __init__(self, filenames, pass_num=1):
+        self._files = list(filenames)
+        self._pass_num = pass_num
+        self.reset()
+
+    def reset(self):
+        self._readers = [RecordIOFileReader(f, self._pass_num)
+                         for f in self._files]
+        self._idx = 0
+
+    def read_next(self):
+        while self._readers:
+            self._idx %= len(self._readers)
+            sample = self._readers[self._idx].read_next()
+            if sample is None:
+                del self._readers[self._idx]
+                continue
+            self._idx += 1
+            return sample
+        return None
+
+
+class RandomDataGenerator(ReaderBase):
+    def __init__(self, low, high, shapes):
+        self._low = low
+        self._high = high
+        self._shapes = shapes
+        self._rs = np.random.RandomState(0)
+
+    def read_next(self):
+        return [(self._rs.uniform(self._low, self._high, s).astype(
+            "float32"), None) for s in self._shapes]
+
+    def reset(self):
+        pass
+
+
+class ShuffleReader(ReaderBase):
+    def __init__(self, underlying, buffer_size):
+        self._u = underlying
+        self._n = buffer_size
+        self._buf = []
+        self._rng = random.Random(0)
+
+    def read_next(self):
+        if not self._buf:
+            while len(self._buf) < self._n:
+                s = self._u.read_next()
+                if s is None:
+                    break
+                self._buf.append(s)
+            self._rng.shuffle(self._buf)
+        if not self._buf:
+            return None
+        return self._buf.pop()
+
+    def reset(self):
+        self._buf = []
+        self._u.reset()
+
+
+class BatchReader(ReaderBase):
+    """stack batch_size samples per slot (reference
+    create_batch_reader_op.cc)."""
+
+    def __init__(self, underlying, batch_size):
+        self._u = underlying
+        self._bs = batch_size
+
+    def read_next(self):
+        samples = []
+        for _ in range(self._bs):
+            s = self._u.read_next()
+            if s is None:
+                break
+            samples.append(s)
+        if not samples:
+            return None
+        n_slots = len(samples[0])
+        batched = []
+        for i in range(n_slots):
+            arrs = [s[i][0] for s in samples]
+            lods = [s[i][1] for s in samples]
+            if lods[0] is not None:
+                # ragged: concat rows, lengths per sample
+                lengths = [a.shape[0] for a in arrs]
+                batched.append((np.concatenate(arrs, 0), [lengths]))
+            else:
+                batched.append((np.stack(arrs, 0), None))
+        return batched
+
+    def reset(self):
+        self._u.reset()
+
+
+class DoubleBufferReader(ReaderBase):
+    """prefetch thread + bounded queue (reference
+    create_double_buffer_reader_op.cc:34-69; the GPU-staging role is played
+    by jax.device_put happening off the consumer's critical path)."""
+
+    _END = object()
+
+    def __init__(self, underlying, capacity=4):
+        self._u = underlying
+        self._cap = capacity
+        self._start()
+
+    def _start(self):
+        # queue + stop flag are captured per-generation: a stale worker that
+        # outlives reset() keeps writing to ITS OWN queue and sees ITS OWN
+        # stop flag, so it can never feed the new generation
+        q = Queue(maxsize=self._cap)
+        stop = threading.Event()
+        u = self._u
+
+        def work():
+            while not stop.is_set():
+                s = u.read_next()
+                q.put(self._END if s is None else s)
+                if s is None:
+                    return
+
+        self._q = q
+        self._stop_evt = stop
+        self._t = threading.Thread(target=work, daemon=True)
+        self._t.start()
+
+    def read_next(self):
+        s = self._q.get()
+        return None if s is self._END else s
+
+    def reset(self):
+        self._stop_evt.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except Exception:
+            pass
+        self._t.join(timeout=5)
+        self._u.reset()
+        self._start()
+
+
+class MultiPassReader(ReaderBase):
+    def __init__(self, underlying, pass_num):
+        self._u = underlying
+        self._pass_num = pass_num
+        self._cur = 0
+
+    def read_next(self):
+        s = self._u.read_next()
+        if s is not None:
+            return s
+        self._cur += 1
+        if self._cur >= self._pass_num:
+            return None
+        self._u.reset()
+        return self._u.read_next()
+
+    def reset(self):
+        self._cur = 0
+        self._u.reset()
+
+
+# ---------------------------------------------------------------------------
+# op kernels (host side)
+# ---------------------------------------------------------------------------
+def _store_reader(ctx, make_reader):
+    """Create-and-store, or reuse: re-running the program must NOT rebuild
+    the reader chain (reference reader_op_registry.cc: creation ops are
+    no-ops when Out already holds a reader)."""
+    op = ctx.current_op
+    name = op.output("Out")[0]
+    existing = ctx.env.get(name)
+    if existing is None and ctx.scope is not None:
+        existing = ctx.scope.find_var(name)
+    if isinstance(existing, ReaderBase):
+        ctx.env[name] = existing
+        return {}
+    reader = make_reader()
+    ctx.env[name] = reader
+    if ctx.scope is not None:
+        ctx.scope.var(name)
+        ctx.scope.set_var(name, reader)
+    return {}
+
+
+@register_op("create_recordio_file_reader", no_trace=True, lod_aware=True)
+def create_recordio_file_reader_op(ctx, ins, attrs):
+    return _store_reader(ctx, lambda: RecordIOFileReader(
+        attrs["filename"], attrs.get("pass_num", 1)))
+
+
+@register_op("open_files", no_trace=True, lod_aware=True)
+def open_files_op(ctx, ins, attrs):
+    return _store_reader(ctx, lambda: MultiFileReader(
+        attrs["filenames"], attrs.get("pass_num", 1)))
+
+
+@register_op("create_random_data_generator", no_trace=True, lod_aware=True)
+def create_random_data_generator_op(ctx, ins, attrs):
+    return _store_reader(ctx, lambda: RandomDataGenerator(
+        attrs.get("low", 0.0), attrs.get("high", 1.0), attrs["shapes"]))
+
+
+def _underlying(ctx, ins):
+    r = ins["UnderlyingReader"][0]
+    if r is None:
+        name = ctx.current_op.input("UnderlyingReader")[0]
+        r = ctx.scope.find_var(name) if ctx.scope else None
+    return r
+
+
+@register_op("create_shuffle_reader", no_trace=True, lod_aware=True)
+def create_shuffle_reader_op(ctx, ins, attrs):
+    return _store_reader(ctx, lambda: ShuffleReader(
+        _underlying(ctx, ins), attrs.get("buffer_size", 1024)))
+
+
+@register_op("create_batch_reader", no_trace=True, lod_aware=True)
+def create_batch_reader_op(ctx, ins, attrs):
+    return _store_reader(ctx, lambda: BatchReader(
+        _underlying(ctx, ins), attrs.get("batch_size", 1)))
+
+
+@register_op("create_double_buffer_reader", no_trace=True, lod_aware=True)
+def create_double_buffer_reader_op(ctx, ins, attrs):
+    return _store_reader(ctx, lambda: DoubleBufferReader(_underlying(ctx, ins)))
+
+
+@register_op("create_multi_pass_reader", no_trace=True, lod_aware=True)
+def create_multi_pass_reader_op(ctx, ins, attrs):
+    return _store_reader(ctx, lambda: MultiPassReader(
+        _underlying(ctx, ins), attrs.get("pass_num", 1)))
+
+
+@register_op("read", no_trace=True, lod_aware=True)
+def read_op(ctx, ins, attrs):
+    reader = ins["Reader"][0]
+    if not isinstance(reader, ReaderBase):
+        name = ctx.current_op.input("Reader")[0]
+        reader = ctx.scope.find_var(name) if ctx.scope else None
+    sample = reader.read_next()
+    if sample is None:
+        raise StopIteration("reader exhausted")
+    vals = []
+    for arr, lod in sample:
+        if lod is not None:
+            lengths = lod[-1] if isinstance(lod[0], (list, tuple)) else lod
+            vals.append(SeqTensor(jnp.asarray(arr),
+                                  jnp.asarray(lengths, jnp.int32)))
+        else:
+            vals.append(jnp.asarray(arr))
+    return out(Out=vals)
+
+
+# reader-creation inputs may be scope-resident (not env) — resolve lazily
+for _t in ("create_shuffle_reader", "create_batch_reader",
+           "create_double_buffer_reader", "create_multi_pass_reader",
+           "read"):
+    _registry.get_op_def(_t).lazy_inputs = True

@@ -50,8 +50,9 @@ def send_vars_op(ctx, ins, attrs):
     op = ctx.current_op
     names = op.input("X")
     epmap = attrs["epmap"]
-    for name, ep in zip(names, epmap):
-        _client(ep).send_var(name, _resolve_value(ctx, name))
+    wire_names = attrs.get("send_as") or names
+    for name, wire, ep in zip(names, wire_names, epmap):
+        _client(ep).send_var(wire, _resolve_value(ctx, name))
     return {}
 
 

@@ -66,8 +66,7 @@ def ring_attention_sharded(q, k, v, axis_name, causal=False, scale=None):
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def hop(carry, hop_idx):
-        k_blk, v_blk, m, l, o = carry
+    def accumulate(hop_idx, k_blk, v_blk, m, l, o):
         # global block index the visiting K/V block came from
         src = (idx - hop_idx) % n
         if causal:
@@ -77,16 +76,25 @@ def ring_attention_sharded(q, k, v, axis_name, causal=False, scale=None):
             bias = jnp.where(mask, 0.0, -jnp.inf)[None, None]
         else:
             bias = None
-        m, l, o = _block_attn(qf, k_blk.astype(jnp.float32),
-                              v_blk.astype(jnp.float32), bias, m, l, o,
-                              scale)
+        return _block_attn(qf, k_blk.astype(jnp.float32),
+                           v_blk.astype(jnp.float32), bias, m, l, o, scale)
+
+    def hop(carry, hop_idx):
+        k_blk, v_blk, m, l, o = carry
+        m, l, o = accumulate(hop_idx, k_blk, v_blk, m, l, o)
         # rotate K/V to the next device (overlaps with next hop's compute)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return (k_blk, v_blk, m, l, o), None
 
-    (k_fin, v_fin, m, l, o), _ = lax.scan(
-        hop, (k, v, m, l, o), jnp.arange(n))
+    if n > 1:
+        # scan the first n-1 hops (each ends in a rotation); the final hop
+        # accumulates only — no wasted trailing K/V rotation over ICI
+        (k_blk, v_blk, m, l, o), _ = lax.scan(
+            hop, (k, v, m, l, o), jnp.arange(n - 1))
+        m, l, o = accumulate(n - 1, k_blk, v_blk, m, l, o)
+    else:
+        m, l, o = accumulate(0, k, v, m, l, o)
     l_safe = jnp.maximum(l, 1e-20)
     return (o / l_safe[..., None]).astype(q.dtype)
 

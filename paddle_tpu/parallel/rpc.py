@@ -24,7 +24,18 @@ import threading
 import numpy as np
 
 __all__ = ["VariableClient", "VariableServer", "serialize_var",
-           "deserialize_var"]
+           "deserialize_var", "RpcError"]
+
+
+class RpcError(RuntimeError):
+    """Typed failure from the variable server (reference PADDLE_ENFORCE on
+    gRPC statuses)."""
+
+
+def _check_ok(resp, what):
+    if resp != ("ok",):
+        detail = resp[1] if isinstance(resp, tuple) and len(resp) > 1 else resp
+        raise RpcError(f"{what} failed: {detail}")
 
 _MAGIC = b"PTRV"
 
@@ -91,25 +102,25 @@ class VariableClient:
 
     def send_var(self, name, value):
         _send_msg(self._sock, ("send", name, serialize_var(value)))
-        resp = _recv_msg(self._sock)
-        assert resp == ("ok",), resp
+        _check_ok(_recv_msg(self._sock), f"send_var({name})")
 
     def get_var(self, name):
         _send_msg(self._sock, ("get", name))
-        tag, payload = _recv_msg(self._sock)
+        resp = _recv_msg(self._sock)
+        tag, payload = resp[0], resp[1]
         if tag == "err":
-            raise KeyError(payload)
+            raise RpcError(f"get_var({name}) failed: {payload}")
         return deserialize_var(payload)
 
     def batch_barrier(self):
         """reference BATCH_BARRIER_MESSAGE after grads sent."""
         _send_msg(self._sock, ("batch_barrier",))
-        assert _recv_msg(self._sock) == ("ok",)
+        _check_ok(_recv_msg(self._sock), "batch_barrier")
 
     def fetch_barrier(self):
         """reference FETCH_BARRIER_MESSAGE after params fetched."""
         _send_msg(self._sock, ("fetch_barrier",))
-        assert _recv_msg(self._sock) == ("ok",)
+        _check_ok(_recv_msg(self._sock), "fetch_barrier")
 
     def shutdown(self):
         try:

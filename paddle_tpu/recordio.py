@@ -1,0 +1,134 @@
+"""RecordIO Python surface over the native C++ library.
+
+Reference parity: paddle/fluid/recordio/ (writer/scanner) +
+python/paddle/fluid/recordio_writer.py (convert_reader_to_recordio_file).
+Records are arbitrary byte strings; the fluid-style tensor convention
+pickles a tuple of (numpy array, lod) per slot.
+"""
+
+import ctypes
+import pickle
+
+import numpy as np
+
+__all__ = ["Writer", "Scanner", "convert_reader_to_recordio_file",
+           "read_recordio_samples"]
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        from .native.build import recordio_lib
+
+        lib = ctypes.CDLL(recordio_lib())
+        lib.rio_writer_open.restype = ctypes.c_void_p
+        lib.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                        ctypes.c_int]
+        lib.rio_writer_write.restype = ctypes.c_int
+        lib.rio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_uint64]
+        lib.rio_writer_flush.argtypes = [ctypes.c_void_p]
+        lib.rio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.rio_scanner_open.restype = ctypes.c_void_p
+        lib.rio_scanner_open.argtypes = [ctypes.c_char_p]
+        lib.rio_scanner_next.restype = ctypes.c_int
+        lib.rio_scanner_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.rio_scanner_reset.argtypes = [ctypes.c_void_p]
+        lib.rio_scanner_close.argtypes = [ctypes.c_void_p]
+        lib.rio_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+        _lib = lib
+    return _lib
+
+
+class Writer:
+    def __init__(self, path, compressor="zlib", max_num_records=1000):
+        lib = _load()
+        self._lib = lib
+        self._h = lib.rio_writer_open(
+            path.encode(), 1 if compressor == "zlib" else 0, max_num_records)
+        if not self._h:
+            raise IOError(f"cannot open {path} for append")
+
+    def write(self, record: bytes):
+        if self._lib.rio_writer_write(self._h, record, len(record)) != 0:
+            raise IOError("recordio write failed")
+
+    def flush(self):
+        if self._lib.rio_writer_flush(self._h) != 0:
+            raise IOError("recordio flush failed")
+
+    def close(self):
+        if self._h:
+            self._lib.rio_writer_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Scanner:
+    def __init__(self, path):
+        lib = _load()
+        self._lib = lib
+        self._h = lib.rio_scanner_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path}")
+
+    def __iter__(self):
+        buf = ctypes.POINTER(ctypes.c_char)()
+        ln = ctypes.c_uint64()
+        while self._lib.rio_scanner_next(
+                self._h, ctypes.byref(buf), ctypes.byref(ln)):
+            data = ctypes.string_at(buf, ln.value)
+            self._lib.rio_free(buf)
+            yield data
+
+    def reset(self):
+        self._lib.rio_scanner_reset(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_scanner_close(self._h)
+            self._h = None
+
+
+def convert_reader_to_recordio_file(filename, reader_creator, feeder=None,
+                                    compressor="zlib", max_num_records=1000):
+    """reference fluid/recordio_writer.py: serialize each sample (optionally
+    through a DataFeeder) into one record. Returns record count.
+
+    Record format (what the reader ops consume): a list of
+    (numpy array, lod-or-None) slot tuples. With a feeder, slots follow
+    feeder.feed_names order."""
+    n = 0
+    with Writer(filename, compressor, max_num_records) as w:
+        for sample in reader_creator():
+            if feeder is not None:
+                fed = feeder.feed([sample])
+                slots = []
+                for name in feeder.feed_names:
+                    t = fed[name]
+                    lod = t.lod() if hasattr(t, "lod") and t.lod() else None
+                    arr = np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+                    slots.append((arr, lod))
+                sample = slots
+            w.write(pickle.dumps(sample, protocol=4))
+            n += 1
+    return n
+
+
+def read_recordio_samples(filename):
+    """Iterate deserialized samples from a recordio file."""
+    s = Scanner(filename)
+    try:
+        for rec in s:
+            yield pickle.loads(rec)
+    finally:
+        s.close()

@@ -106,7 +106,7 @@ class DistributeTranspiler:
         # create split vars on the trainer side
         self.param_var_mapping = self._create_vars_from_blocklist(program, param_blocks)
         self.grad_var_mapping = self._create_vars_from_blocklist(
-            program, grad_blocks, add_trainer_suffix=self.trainer_num > 1
+            program, grad_blocks
         )
         self.grad_param_mapping = {}
         for g, p in zip(grad_blocks, param_blocks):
@@ -149,12 +149,24 @@ class DistributeTranspiler:
 
         # send ops
         dummy_output = block.create_var(name="RPC_OP_ROLE_DUMMY")
+        # multi-trainer sync: each trainer sends its grads under a
+        # trainer-suffixed WIRE name so the pserver's per-trainer recv
+        # buffers (and its aggregating sum op) see distinct vars — the
+        # reference renames the local grad vars instead
+        # (add_trainer_suffix); a wire alias keeps the trainer program
+        # untouched
+        if self.sync_mode and self.trainer_num > 1:
+            send_as = [f"{v.name}.trainer_{self.trainer_id}"
+                       for v in send_vars]
+        else:
+            send_as = [v.name for v in send_vars]
         block.append_op(
             "send_vars",
             {"X": send_vars},
             {"Out": [dummy_output]},
             {
                 "epmap": eplist_all,
+                "send_as": send_as,
                 "sync_send": self.sync_mode,
                 OP_ROLE_ATTR_NAME: RPC_OP_ROLE_ATTR_VALUE,
                 OP_ROLE_VAR_ATTR_NAME: [v.name for v in send_vars],
@@ -410,7 +422,7 @@ class DistributeTranspiler:
                     )
         return opt_ops, params_grads
 
-    def _create_vars_from_blocklist(self, program, block_list, add_trainer_suffix=False):
+    def _create_vars_from_blocklist(self, program, block_list):
         """reference create_vars_from_blocklist — materialize split vars."""
         block_map = {}
         var_mapping = {}

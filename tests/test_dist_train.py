@@ -203,3 +203,59 @@ def test_async_mode_updates_without_barriers():
         c.shutdown()
     finally:
         server.stop()
+
+
+def test_sync_two_trainers_grads_aggregate():
+    """2-trainer sync round: pserver sums per-trainer grad buffers and
+    serves the updated param (reference multi-trainer sync mode with
+    .trainer_<id> recv buffers)."""
+    import threading
+
+    pscope = fluid.Scope()
+    started = threading.Event()
+
+    def pserver():
+        with fluid.scope_guard(pscope):
+            with program_guard(Program(), Program()):
+                _build_trainer_style_program()
+                t = fluid.DistributeTranspiler()
+                t.transpile(trainer_id=0, pservers="127.0.0.1:6310",
+                            trainers=2)
+                pp = t.get_pserver_program("127.0.0.1:6310")
+                sp = t.get_startup_program("127.0.0.1:6310", pp)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(sp)
+                started.set()
+                exe.run(pp)
+
+    th = threading.Thread(target=pserver, daemon=True)
+    th.start()
+    assert started.wait(60)
+    time.sleep(0.5)
+
+    def trainer(tid, results):
+        # fresh client cache per thread is NOT possible (module-global),
+        # so use raw clients to emulate the second trainer's RPC traffic
+        from paddle_tpu.parallel.rpc import VariableClient
+        c = VariableClient("127.0.0.1:6310")
+        g = np.full((4, 2), float(tid + 1), dtype="float32")
+        c.send_var(f"W@GRAD.trainer_{tid}", g)
+        c.batch_barrier()
+        w = c.get_var("W")
+        c.fetch_barrier()
+        results[tid] = np.asarray(w)
+        c.shutdown() if tid == 99 else None
+
+    w0 = None
+    with fluid.scope_guard(pscope):
+        pass
+    results = {}
+    t0 = threading.Thread(target=trainer, args=(0, results))
+    t1 = threading.Thread(target=trainer, args=(1, results))
+    t0.start(); t1.start()
+    t0.join(30); t1.join(30)
+    assert 0 in results and 1 in results
+    # both trainers see the same post-update param
+    np.testing.assert_allclose(results[0], results[1])
+    from paddle_tpu.parallel.rpc import VariableClient
+    VariableClient("127.0.0.1:6310").shutdown()
