@@ -82,6 +82,7 @@ from .param_attr import ParamAttr, WeightNormParamAttr
 from .data_feeder import DataFeeder
 from .trainer import Trainer, BeginEpochEvent, EndEpochEvent, BeginStepEvent, EndStepEvent
 from .inferencer import Inferencer
+from . import amp
 from . import transpiler
 from .transpiler import DistributeTranspiler, InferenceTranspiler, memory_optimize, release_memory
 from .unique_name import generate as _generate_unique_name

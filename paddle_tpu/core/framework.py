@@ -138,6 +138,10 @@ class Variable:
     def __sub__(self, other):
         return self._binary(other, "elementwise_sub")
 
+    def __rsub__(self, other):
+        # other - self: scale(-1) then add the scalar/tensor
+        return (-self) + other
+
     def __mul__(self, other):
         return self._binary(other, "elementwise_mul")
 
@@ -145,6 +149,12 @@ class Variable:
 
     def __truediv__(self, other):
         return self._binary(other, "elementwise_div")
+
+    def __rtruediv__(self, other):
+        from .. import layers
+
+        # other / self via reciprocal (reference layers/ops.py reciprocal op)
+        return layers.reciprocal(self) * other
 
     def __neg__(self):
         from .. import layers

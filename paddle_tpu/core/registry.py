@@ -282,6 +282,9 @@ def make_vjp_kernel(fwd_def):
 # non-lod-aware kernels + LoD propagation (reference ShareLoD semantics).
 # ---------------------------------------------------------------------------
 def run_kernel(op_def, ctx, ins, attrs):
+    from .. import amp
+
+    ins = amp.apply_policy(op_def.type, ins)
     if op_def.lod_aware:
         return op_def.fn(ctx, ins, attrs)
 

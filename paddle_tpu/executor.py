@@ -10,6 +10,7 @@ interpret mode, matching the reference's op-by-op Executor semantics.
 import numpy as np
 import jax
 
+from . import amp
 from .core import executor_core, registry
 from .core.framework import Program, Variable, default_main_program
 from .core.lod_tensor import LoDTensor
@@ -121,6 +122,7 @@ class Executor:
             tuple(sorted((n, executor_core.spec_of(v)) for n, v in feed_vals.items())),
             tuple(fetch_names),
             tuple(state_names),
+            amp.fingerprint(),
         )
         entry = self._compile_cache.get(cache_key) if use_cache else None
         if entry is None:

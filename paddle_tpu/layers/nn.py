@@ -649,28 +649,46 @@ def sequence_expand(x, y, ref_level=-1, name=None):
     return out
 
 
-def beam_search(pre_ids, ids, scores, beam_size, end_id, level=0):
-    """reference layers/nn.py:1936 — one beam-search step over LoD beams."""
+def beam_search(pre_ids, ids, scores, beam_size, end_id, level=0,
+                pre_scores=None, return_parents=False):
+    """reference layers/nn.py:1936 — one beam-search step over beams
+    (ops/beam_search_ops.py: dense [B*beam_size] slots instead of 2-level
+    LoD; pass pre_scores for exact finished-beam carry, request
+    return_parents to drive beam_search_decode's backtrack)."""
     helper = LayerHelper("beam_search", **locals())
     selected_scores = helper.create_tmp_variable(dtype=scores.dtype, lod_level=2)
-    selected_ids = helper.create_tmp_variable(dtype=ids.dtype, lod_level=2)
+    selected_ids = helper.create_tmp_variable(
+        dtype=ids.dtype if ids is not None else "int64", lod_level=2)
+    parent_idx = helper.create_tmp_variable(dtype="int64", stop_gradient=True)
+    inputs = {"pre_ids": [pre_ids], "scores": [scores]}
+    if ids is not None:
+        inputs["ids"] = [ids]
+    if pre_scores is not None:
+        inputs["pre_scores"] = [pre_scores]
     helper.append_op(
         "beam_search",
-        {"pre_ids": [pre_ids], "ids": [ids], "scores": [scores]},
-        {"selected_ids": [selected_ids], "selected_scores": [selected_scores]},
+        inputs,
+        {"selected_ids": [selected_ids], "selected_scores": [selected_scores],
+         "parent_idx": [parent_idx]},
         {"level": level, "beam_size": beam_size, "end_id": end_id},
     )
+    if return_parents:
+        return selected_ids, selected_scores, parent_idx
     return selected_ids, selected_scores
 
 
-def beam_search_decode(ids, scores, name=None):
+def beam_search_decode(ids, scores, name=None, parents=None, end_id=-1):
     helper = LayerHelper("beam_search_decode", **locals())
     sentence_ids = helper.create_tmp_variable(dtype=ids.dtype, lod_level=2)
     sentence_scores = helper.create_tmp_variable(dtype=scores.dtype, lod_level=2)
+    inputs = {"Ids": [ids], "Scores": [scores]}
+    if parents is not None:
+        inputs["Parents"] = [parents]
     helper.append_op(
         "beam_search_decode",
-        {"Ids": [ids], "Scores": [scores]},
+        inputs,
         {"SentenceIds": [sentence_ids], "SentenceScores": [sentence_scores]},
+        {"end_id": end_id},
     )
     return sentence_ids, sentence_scores
 

@@ -11,8 +11,14 @@
 //          | stored bytes
 //   raw bytes := n_records x (u32 len | payload)
 // All integers little-endian. A torn final chunk (bad magic/short read/CRC
-// mismatch) terminates the scan cleanly — earlier chunks stay readable,
-// which is the fault-tolerant-append property the reference documents.
+// mismatch/implausible length) terminates the scan cleanly — earlier chunks
+// stay readable, which is the fault-tolerant-append property the reference
+// documents.
+//
+// NOTE: only the *API* is reference parity. The on-disk layout is NOT the
+// reference's (magic 0x01020304, {num_records, checksum, compressor,
+// compress_size} header, snappy/gzip codecs) — files are not interchangeable
+// between the two toolchains.
 
 #include <cstdint>
 #include <cstdio>
@@ -85,7 +91,12 @@ bool write_chunk(Writer* w) {
   return true;
 }
 
-bool read_chunk(Scanner* s) {
+// Cap on a single decoded chunk: headers claiming more than this are treated
+// as corruption, not honored with a giant allocation that could abort the
+// embedding process via bad_alloc across the C ABI.
+constexpr uint64_t kMaxChunkBytes = 1ull << 30;
+
+bool read_chunk(Scanner* s) try {
   uint32_t magic = 0, n = 0, codec = 0, crc = 0;
   uint64_t raw_len = 0, stored_len = 0;
   if (fread(&magic, 4, 1, s->f) != 1 || magic != kMagic) return false;
@@ -94,6 +105,17 @@ bool read_chunk(Scanner* s) {
   if (fread(&raw_len, 8, 1, s->f) != 1) return false;
   if (fread(&stored_len, 8, 1, s->f) != 1) return false;
   if (fread(&crc, 4, 1, s->f) != 1) return false;
+  if (raw_len > kMaxChunkBytes || stored_len > kMaxChunkBytes) return false;
+  // A stored_len larger than the bytes left in the file is a torn/corrupt
+  // header; reject before allocating.
+  long cur = ftell(s->f);
+  if (cur >= 0 && fseek(s->f, 0, SEEK_END) == 0) {
+    long end = ftell(s->f);
+    if (fseek(s->f, cur, SEEK_SET) != 0) return false;
+    if (end >= 0 && stored_len > static_cast<uint64_t>(end - cur)) {
+      return false;
+    }
+  }
   std::string stored(stored_len, '\0');
   if (stored_len &&
       fread(&stored[0], stored_len, 1, s->f) != 1) return false;
@@ -124,6 +146,10 @@ bool read_chunk(Scanner* s) {
     off += len;
   }
   return true;
+} catch (...) {
+  // Corruption-triggered allocation/decode failure must end the scan, not
+  // propagate across the extern "C" boundary and abort the process.
+  return false;
 }
 
 }  // namespace
@@ -142,6 +168,17 @@ void* rio_writer_open(const char* path, int codec, int max_records) {
 
 int rio_writer_write(void* wp, const char* buf, uint64_t len) {
   auto* w = static_cast<Writer*>(wp);
+  // Writer enforces the same chunk bound the scanner trusts (kMaxChunkBytes):
+  // a record that cannot fit in one chunk is an error here, not silent data
+  // loss at read time; a record that would overflow the pending chunk
+  // flushes first.
+  uint64_t framed = len + 4;
+  if (framed + 4 * (w->pending.size() + 1) + w->pending_bytes >
+      kMaxChunkBytes) {
+    if (w->pending.empty()) return -1;  // single record exceeds the format cap
+    if (!write_chunk(w)) return -1;
+    if (framed + 4 > kMaxChunkBytes) return -1;
+  }
   w->pending.emplace_back(buf, len);
   w->pending_bytes += len;
   if (w->pending.size() >= w->max_records ||

@@ -12,6 +12,8 @@ from . import tensor_ops
 from . import nn_ops
 from . import optimizer_ops
 from . import sequence_ops
+from . import loss_ops
+from . import beam_search_ops
 from . import rnn_ops
 from . import control_flow_ops
 from . import io_ops

@@ -1,0 +1,146 @@
+"""Beam search: per-step selection + final backtrack decode.
+
+Reference parity: operators/beam_search_op.cc:1 and
+operators/beam_search_decode_op.cc:1. The reference tracks beams with
+2-level LoD (source, beam) and variable beam widths; the TPU design is
+dense and static-shaped: every source keeps exactly `beam_size` slots
+([B*K] row blocks, src-major), finished beams (pre_id == end_id) carry a
+single (end_id, pre_score) candidate, and inactive slots (pre_id < 0,
+used to seed step 0 with one live beam) produce no candidates. Selection
+is one lax.top_k over [B, K*C] — MXU/VPU-friendly, no host loop. Parent
+pointers are an explicit output (the reference encodes them in the LoD
+chain), and beam_search_decode backtracks them over the step arrays.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op, set_stop_gradient_outputs, SeqTensor
+from .util import first, out
+
+NEG_INF = -1e9
+
+
+def _flat(v):
+    if isinstance(v, SeqTensor):
+        v = v.data
+    return v
+
+
+@register_op("beam_search", lod_aware=True)
+def beam_search_op(ctx, ins, attrs):
+    """One step: pick top beam_size of K*C candidates per source.
+
+    pre_ids [B*K,1]; ids [B*K,C] (optional — defaults to the column index,
+    the whole-vocabulary case, avoiding a [B*K,V] host feed); scores
+    [B*K,C] (accumulated candidate scores); optional pre_scores [B*K,1].
+    Outputs selected_ids, selected_scores, parent_idx — all [B*K,1];
+    parent_idx is the flat row of each selection's source beam (reference:
+    implied by the output LoD).
+    """
+    pre_ids = _flat(first(ins, "pre_ids"))
+    ids = _flat(first(ins, "ids"))
+    scores = _flat(first(ins, "scores"))
+    if ids is None:
+        ids = jnp.broadcast_to(
+            jnp.arange(scores.shape[1], dtype=jnp.int32)[None, :],
+            scores.shape)
+    pre_scores = _flat(first(ins, "pre_scores"))
+    K = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+
+    BK = pre_ids.shape[0]
+    assert BK % K == 0, f"rows {BK} not a multiple of beam_size {K}"
+    B = BK // K
+    C = ids.shape[1]
+    pre_id = pre_ids.reshape(BK).astype(jnp.int32)
+    scores = scores.astype(jnp.float32)
+
+    finished = pre_id == end_id
+    inactive = pre_id < 0
+    if pre_scores is None:
+        pre_sc = scores[:, 0]
+    else:
+        pre_sc = pre_scores.reshape(BK).astype(jnp.float32)
+
+    # finished beams: only candidate 0 = (end_id, unchanged score)
+    col = jnp.arange(C)[None, :]
+    cand_scores = jnp.where(
+        finished[:, None], jnp.where(col == 0, pre_sc[:, None], NEG_INF),
+        scores)
+    cand_scores = jnp.where(inactive[:, None], NEG_INF, cand_scores)
+    cand_ids = jnp.where(finished[:, None], end_id, ids.astype(jnp.int32))
+
+    flat_scores = cand_scores.reshape(B, K * C)
+    top_sc, top_ix = jax.lax.top_k(flat_scores, K)          # [B,K]
+    parent_beam = top_ix // C                               # beam in source
+    parent_flat = parent_beam + jnp.arange(B)[:, None] * K  # [B,K]
+    sel_ids = cand_ids.reshape(B, K * C)[jnp.arange(B)[:, None], top_ix]
+    return out(
+        selected_ids=sel_ids.reshape(BK, 1),
+        selected_scores=top_sc.reshape(BK, 1),
+        parent_idx=parent_flat.reshape(BK, 1),
+    )
+
+
+set_stop_gradient_outputs(
+    "beam_search", ["selected_ids", "selected_scores", "parent_idx"])
+
+
+@register_op("beam_search_decode", lod_aware=True)
+def beam_search_decode_op(ctx, ins, attrs):
+    """Backtrack parent pointers over the per-step arrays into sentences.
+
+    Ids/Scores/Parents: TensorArrays (or stacked [T,B*K,1] tensors) written
+    once per decode step. Output SentenceIds/SentenceScores: SeqTensor of
+    B*K sentences (src-major, beam-minor — the reference's 2-level LoD
+    flattened), each trimmed at its first end_id."""
+    from .control_flow_ops import TensorArray
+
+    def stacked(v):
+        if isinstance(v, TensorArray):
+            return jnp.stack([_flat(x).reshape(-1) for x in v.items])
+        v = _flat(v)
+        return v.reshape(v.shape[0], -1)
+
+    ids = stacked(first(ins, "Ids")).astype(jnp.int32)      # [T,BK]
+    scores = stacked(first(ins, "Scores")).astype(jnp.float32)
+    parents_in = first(ins, "Parents")
+    T, BK = ids.shape
+    if parents_in is None:
+        parents = jnp.broadcast_to(jnp.arange(BK)[None, :], (T, BK))
+    else:
+        parents = stacked(parents_in).astype(jnp.int32)
+    end_id = int(attrs.get("end_id", -1))
+
+    # reverse scan: walk each final slot back through the parent chain
+    def back(idx, t):
+        tok = ids[t][idx]
+        sc = scores[t][idx]
+        idx_prev = parents[t][idx]
+        return idx_prev, (tok, sc)
+
+    idx0 = jnp.arange(BK)
+    _, (toks_rev, scs_rev) = jax.lax.scan(
+        back, idx0, jnp.arange(T)[::-1])
+    toks = toks_rev[::-1].T                                 # [BK,T]
+    scs = scs_rev[::-1].T
+
+    if end_id >= 0:
+        is_end = toks == end_id
+        any_end = is_end.any(axis=1)
+        first_end = jnp.argmax(is_end, axis=1)
+        lengths = jnp.where(any_end, first_end + 1, T).astype(jnp.int32)
+    else:
+        lengths = jnp.full((BK,), T, jnp.int32)
+
+    from .sequence_ops import padded_to_seq
+    sent_ids = padded_to_seq(toks[:, :, None], lengths, BK * T)
+    sent_scores = padded_to_seq(scs[:, :, None], lengths, BK * T)
+    return out(SentenceIds=sent_ids, SentenceScores=sent_scores)
+
+
+set_stop_gradient_outputs(
+    "beam_search_decode", ["SentenceIds", "SentenceScores"])

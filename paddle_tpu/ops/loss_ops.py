@@ -1,0 +1,413 @@
+"""Structured-prediction losses + reductions: CTC, CRF, NCE, hsigmoid.
+
+Reference parity:
+  warpctc            operators/warpctc_op.cc:1 (CTC loss over LoD logits)
+  ctc_align          operators/ctc_align_op.cc (merge repeats, drop blanks)
+  linear_chain_crf   operators/linear_chain_crf_op.cc:1
+  crf_decoding       operators/crf_decoding_op.cc:1 (Viterbi)
+  nce                operators/nce_op.cc:1 (noise-contrastive estimation)
+  hierarchical_sigmoid  operators/hierarchical_sigmoid_op.cc
+  reduce_*           operators/reduce_op.cc family
+
+TPU design notes: every sequential recurrence (CTC/CRF forward algorithm,
+Viterbi) is a lax.scan over the padded time axis in log space — static
+shapes, no data-dependent Python control flow; ragged batches arrive as
+SeqTensor and are padded/masked, so XLA sees one fused computation.
+Gradients come from the registry's vjp fallback (all kernels are
+deterministic jnp code) except nce, whose class sampling must be replayed
+exactly in the backward pass (explicit grad op carries SampleLabels).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import (register_op, register_grad_maker,
+                             set_stop_gradient_outputs, SeqTensor)
+from .util import first, out
+from .sequence_ops import seq_to_padded, padded_to_seq
+
+
+def _as_seq(x):
+    if isinstance(x, SeqTensor):
+        return x
+    # degenerate: one sequence spanning all rows
+    return SeqTensor(x, jnp.asarray([x.shape[0]], jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Reductions (reference operators/reduce_op.cc: reduce_sum/mean/max/min/prod)
+# ---------------------------------------------------------------------------
+def _reduce_kernel(name, fn):
+    @register_op(name)
+    def _k(ctx, ins, attrs, _fn=fn):
+        x = first(ins, "X")
+        if attrs.get("reduce_all", False):
+            axes = None
+        else:
+            dim = attrs.get("dim", 0)
+            axes = tuple(d % x.ndim for d in
+                         (dim if isinstance(dim, (list, tuple)) else [dim]))
+        return out(Out=_fn(x, axes, attrs.get("keep_dim", False)))
+
+    return _k
+
+
+_reduce_kernel("reduce_sum", lambda x, a, k: jnp.sum(x, axis=a, keepdims=k))
+_reduce_kernel("reduce_mean", lambda x, a, k: jnp.mean(x, axis=a, keepdims=k))
+_reduce_kernel("reduce_max", lambda x, a, k: jnp.max(x, axis=a, keepdims=k))
+_reduce_kernel("reduce_min", lambda x, a, k: jnp.min(x, axis=a, keepdims=k))
+_reduce_kernel("reduce_prod", lambda x, a, k: jnp.prod(x, axis=a, keepdims=k))
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+@register_op("warpctc", lod_aware=True)
+def warpctc_op(ctx, ins, attrs):
+    """CTC loss (reference operators/warpctc_op.cc:1; the reference dynloads
+    the warp-ctc library — here the loss is optax.ctc_loss, a lax.scan
+    forward algorithm in log space that XLA fuses with the rest of the step).
+
+    Logits: SeqTensor [sum_T, C] (pre-softmax, ragged over time)
+    Label:  SeqTensor [sum_L, 1] int
+    -> Loss [B, 1]; WarpCTCGrad = dLoss/dLogits (SeqTensor, same shape as
+       Logits — the reference materializes it in the forward pass; XLA DCEs
+       it when unused because training grads flow through the vjp fallback).
+    """
+    import optax
+
+    logits = _as_seq(first(ins, "Logits"))
+    label = _as_seq(first(ins, "Label"))
+    blank = int(attrs.get("blank", 0))
+    norm_by_times = attrs.get("norm_by_times", False)
+
+    B = logits.batch
+    T = int(logits.ntokens)
+    L = int(label.ntokens)
+    lp = seq_to_padded(logits, T).astype(jnp.float32)          # [B,T,C]
+    lab = seq_to_padded(label, L).reshape(B, L).astype(jnp.int32)
+
+    t_pad = (jnp.arange(T)[None, :] >=
+             logits.lengths[:, None]).astype(jnp.float32)      # [B,T]
+    l_pad = (jnp.arange(L)[None, :] >=
+             label.lengths[:, None]).astype(jnp.float32)       # [B,L]
+
+    def loss_fn(logits_padded):
+        per_seq = optax.ctc_loss(logits_padded, t_pad, lab, l_pad,
+                                 blank_id=blank)
+        if norm_by_times:
+            per_seq = per_seq / jnp.maximum(
+                logits.lengths.astype(jnp.float32), 1.0)
+        return per_seq
+
+    per_seq, vjp = jax.vjp(loss_fn, lp)
+    (dlogits,) = vjp(jnp.ones_like(per_seq))
+    grad_seq = padded_to_seq(dlogits.astype(logits.data.dtype),
+                             logits.lengths, T)
+    return out(Loss=per_seq[:, None], WarpCTCGrad=grad_seq)
+
+
+set_stop_gradient_outputs("warpctc", ["WarpCTCGrad"])
+
+
+@register_op("ctc_align", lod_aware=True)
+def ctc_align_op(ctx, ins, attrs):
+    """Merge repeated tokens then drop blanks, per sequence (reference
+    operators/ctc_align_op.cc). Vectorized compaction: keep-mask + segment
+    cumsum instead of a per-token host loop."""
+    x = _as_seq(first(ins, "Input"))
+    blank = int(attrs.get("blank", 0))
+    merge = attrs.get("merge_repeated", True)
+
+    data = x.data.reshape(x.ntokens)
+    seg = x.segment_ids()
+    offs = x.offsets()
+    B, n = x.batch, x.ntokens
+    idx = jnp.arange(n)
+    is_seq_start = idx == offs[jnp.clip(seg, 0, B - 1)]
+    prev = jnp.concatenate([data[:1], data[:-1]])
+    keep = data != blank
+    if merge:
+        keep &= is_seq_start | (data != prev)
+    keep &= seg < B  # padding rows never kept
+
+    csum = jnp.cumsum(keep.astype(jnp.int32))
+    exc = csum - keep.astype(jnp.int32)
+    seg_start_exc = exc[jnp.clip(offs[jnp.clip(seg, 0, B - 1)], 0, n - 1)]
+    pos_new = exc - seg_start_exc
+    new_lengths = jax.ops.segment_sum(
+        keep.astype(jnp.int32), seg, num_segments=B + 1)[:B]
+    new_offs = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(new_lengths)])
+    dest = new_offs[jnp.clip(seg, 0, B - 1)] + pos_new
+    o = jnp.zeros((n,), data.dtype)
+    o = o.at[jnp.where(keep, dest, n)].set(data, mode="drop")
+    return out(Output=SeqTensor(o[:, None], new_lengths))
+
+
+# ---------------------------------------------------------------------------
+# Linear-chain CRF
+# ---------------------------------------------------------------------------
+def _crf_unpack(transition):
+    """Transition [C+2, C]: row 0 start, row 1 stop, rows 2.. pairwise
+    (reference linear_chain_crf_op.h layout)."""
+    return transition[0], transition[1], transition[2:]
+
+
+def _crf_padded(emission, label=None):
+    e = _as_seq(emission)
+    B, T = e.batch, int(e.ntokens)
+    ep = seq_to_padded(e, T).astype(jnp.float32)       # [B,T,C]
+    lens = e.lengths.astype(jnp.int32)
+    lab = None
+    if label is not None:
+        l = _as_seq(label)
+        lab = seq_to_padded(l, T).reshape(B, T).astype(jnp.int32)
+    return e, ep, lens, lab, B, T
+
+
+@register_op("linear_chain_crf", lod_aware=True)
+def linear_chain_crf_op(ctx, ins, attrs):
+    """Negative log-likelihood of a linear-chain CRF (reference
+    operators/linear_chain_crf_op.cc:1). The reference runs the forward
+    algorithm in exp space with row-max rescaling; here it is one lax.scan
+    in log space (numerically strictly better, MXU-free but fully fused)."""
+    e, ep, lens, lab, B, T = _crf_padded(first(ins, "Emission"),
+                                         first(ins, "Label"))
+    start_w, stop_w, trans = _crf_unpack(
+        first(ins, "Transition").astype(jnp.float32))
+    C = ep.shape[-1]
+    ts = jnp.arange(T)
+
+    # --- partition function: alpha scan in log space
+    a0 = start_w[None, :] + ep[:, 0]                   # [B,C]
+
+    def step(a, t):
+        nxt = jax.scipy.special.logsumexp(
+            a[:, :, None] + trans[None, :, :], axis=1) + ep[:, t]
+        a = jnp.where((t < lens)[:, None], nxt, a)
+        return a, a
+
+    aT, alphas = jax.lax.scan(step, a0, ts[1:])        # alphas [T-1,B,C]
+    all_alphas = jnp.concatenate([a0[None], alphas], 0)  # [T,B,C]
+    logZ = jax.scipy.special.logsumexp(aT + stop_w[None, :], axis=1)  # [B]
+
+    # --- gold path score
+    tok_mask = (ts[None, :] < lens[:, None]).astype(jnp.float32)
+    em_score = jnp.sum(
+        jnp.take_along_axis(ep, lab[:, :, None], axis=2)[..., 0] * tok_mask,
+        axis=1)
+    pair = trans[lab[:, :-1], lab[:, 1:]]              # [B,T-1]
+    pair_mask = (ts[None, 1:] < lens[:, None]).astype(jnp.float32)
+    tr_score = jnp.sum(pair * pair_mask, axis=1)
+    last = jnp.take_along_axis(lab, (lens - 1)[:, None], axis=1)[:, 0]
+    score = (em_score + tr_score + start_w[lab[:, 0]] + stop_w[last])
+
+    nll = (logZ - score)[:, None]                      # [B,1]
+
+    # reference intermediates (exp space, row-max rescaled)
+    e_max = jnp.max(ep, axis=-1, keepdims=True)
+    em_exps = padded_to_seq(jnp.exp(ep - e_max), lens, int(e.ntokens))
+    alpha_seq = padded_to_seq(
+        jnp.transpose(all_alphas, (1, 0, 2)), lens, int(e.ntokens))
+    return out(LogLikelihood=nll.astype(e.data.dtype),
+               Alpha=alpha_seq,
+               EmissionExps=em_exps,
+               TransitionExps=jnp.exp(first(ins, "Transition")))
+
+
+set_stop_gradient_outputs(
+    "linear_chain_crf", ["Alpha", "EmissionExps", "TransitionExps"])
+
+
+@register_op("crf_decoding", lod_aware=True)
+def crf_decoding_op(ctx, ins, attrs):
+    """Viterbi decode (reference operators/crf_decoding_op.cc:1): max-product
+    forward scan storing argmax backpointers, then a reverse scan backtrack.
+    With Label given, emits the per-token correctness mask instead (the
+    reference contract used by ChunkEvaluator)."""
+    label_in = first(ins, "Label")
+    e, ep, lens, lab, B, T = _crf_padded(first(ins, "Emission"), label_in)
+    start_w, stop_w, trans = _crf_unpack(
+        first(ins, "Transition").astype(jnp.float32))
+    C = ep.shape[-1]
+    ts = jnp.arange(T)
+
+    d0 = start_w[None, :] + ep[:, 0]
+
+    def fwd(d, t):
+        cand = d[:, :, None] + trans[None, :, :]        # [B,C_prev,C]
+        best_prev = jnp.argmax(cand, axis=1)            # [B,C]
+        nxt = jnp.max(cand, axis=1) + ep[:, t]
+        active = (t < lens)[:, None]
+        d = jnp.where(active, nxt, d)
+        return d, jnp.where(active, best_prev, -1)
+
+    dT, bps = jax.lax.scan(fwd, d0, ts[1:])             # bps [T-1,B,C]
+    last_tag = jnp.argmax(dT + stop_w[None, :], axis=1)  # [B]
+
+    def back(tag, t):
+        bp = bps[t]                                      # [B,C]
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # t indexes the transition into step t+1; only steps < len-1 real
+        tag_prev = jnp.where(t + 1 < lens, prev, tag)
+        return tag_prev, tag_prev
+
+    _, rev_tags = jax.lax.scan(back, last_tag, ts[:-1][::-1])
+    path = jnp.concatenate([rev_tags[::-1], last_tag[None]], 0)  # [T,B]
+    path = jnp.transpose(path)                           # [B,T]
+
+    if lab is not None:
+        path = (path == lab).astype(jnp.int32)
+    seq = padded_to_seq(path[:, :, None].astype(jnp.int32), lens,
+                        int(e.ntokens))
+    return out(ViterbiPath=seq)
+
+
+set_stop_gradient_outputs("crf_decoding", ["ViterbiPath"])
+
+
+# ---------------------------------------------------------------------------
+# NCE
+# ---------------------------------------------------------------------------
+def _nce_cost(x, w, b, label, samples, num_total_classes):
+    """Deterministic NCE cost given sampled negative classes.
+
+    x [B,D], w [C,D], b [C,1], label [B,Tt], samples [B,K].
+    Uniform noise q = 1/C (reference nce_op.h uses a uniform Sampler)."""
+    B, num_true = label.shape
+    K = samples.shape[1]
+    all_cls = jnp.concatenate([label, samples], axis=1)      # [B,Tt+K]
+    wv = w[all_cls]                                          # [B,Tt+K,D]
+    logits = jnp.einsum("bd,bkd->bk", x, wv) + b[all_cls, 0]
+    log_kq = jnp.log(jnp.asarray(K / num_total_classes, jnp.float32))
+    adj = logits.astype(jnp.float32) - log_kq
+    pos = jax.nn.softplus(-adj[:, :num_true]).sum(axis=1)
+    neg = jax.nn.softplus(adj[:, num_true:]).sum(axis=1)
+    return (pos + neg)[:, None], logits, all_cls
+
+
+@register_op("nce", lod_aware=True)
+def nce_op(ctx, ins, attrs):
+    """reference operators/nce_op.cc:1. Samples once per step from the
+    executor's RNG; SampleLabels is exported so nce_grad replays the exact
+    same samples (randomness must not be re-drawn in the backward pass)."""
+    x = first(ins, "Input")
+    label = first(ins, "Label")
+    if isinstance(x, SeqTensor):
+        x = x.data
+    if isinstance(label, SeqTensor):
+        label = label.data
+    w, b = first(ins, "Weight"), first(ins, "Bias")
+    C = int(attrs["num_total_classes"])
+    K = int(attrs.get("num_neg_samples", 10))
+    label = label.reshape(x.shape[0], -1).astype(jnp.int32)
+    custom = attrs.get("custom_neg_classes")
+    if custom:
+        # fixed negatives (reference nce_op attr custom_neg_classes — the
+        # deterministic path its own op tests rely on)
+        samples = jnp.broadcast_to(
+            jnp.asarray(custom, jnp.int32)[None, :], (x.shape[0], len(custom)))
+    else:
+        samples = jax.random.randint(
+            ctx.next_rng(), (x.shape[0], K), 0, C, jnp.int32)
+    cost, logits, all_cls = _nce_cost(x, w, b, label, samples, C)
+    return out(Cost=cost.astype(x.dtype), SampleLogits=logits,
+               SampleLabels=all_cls)
+
+
+set_stop_gradient_outputs("nce", ["SampleLogits", "SampleLabels"])
+
+
+@register_op("nce_grad", lod_aware=True)
+def nce_grad_op(ctx, ins, attrs):
+    x = first(ins, "Input")
+    label = first(ins, "Label")
+    if isinstance(x, SeqTensor):
+        x = x.data
+    if isinstance(label, SeqTensor):
+        label = label.data
+    w, b = first(ins, "Weight"), first(ins, "Bias")
+    all_cls = first(ins, "SampleLabels")
+    g = first(ins, "Cost@GRAD")
+    if isinstance(g, SeqTensor):
+        g = g.data
+    C = int(attrs["num_total_classes"])
+    label = label.reshape(x.shape[0], -1).astype(jnp.int32)
+    num_true = label.shape[1]
+    samples = all_cls[:, num_true:]
+
+    def f(x_, w_, b_):
+        return _nce_cost(x_, w_, b_, label, samples, C)[0]
+
+    _, vjp = jax.vjp(f, x, w, b)
+    dx, dw, db = vjp(g.reshape(x.shape[0], 1).astype(jnp.float32)
+                     .astype(x.dtype))
+    return {"Input@GRAD": [dx], "Weight@GRAD": [dw], "Bias@GRAD": [db]}
+
+
+@register_grad_maker("nce")
+def nce_grad_maker(op, gout, gin):
+    return [dict(
+        type="nce_grad",
+        inputs={
+            "Input": op.input("Input"),
+            "Label": op.input("Label"),
+            "Weight": op.input("Weight"),
+            "Bias": op.input("Bias"),
+            "SampleLabels": op.output("SampleLabels"),
+            "Cost@GRAD": gout["Cost"],
+        },
+        outputs={
+            "Input@GRAD": gin["Input"],
+            "Weight@GRAD": gin["Weight"],
+            "Bias@GRAD": gin["Bias"],
+        },
+        attrs=dict(op.attrs),
+    )]
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical sigmoid
+# ---------------------------------------------------------------------------
+@register_op("hierarchical_sigmoid", lod_aware=True)
+def hierarchical_sigmoid_op(ctx, ins, attrs):
+    """reference operators/hierarchical_sigmoid_op.cc: implicit complete
+    binary tree over num_classes leaves (the reference MatrixBitCode). The
+    whole path walk is vectorized over a static max depth — no host loop."""
+    x = first(ins, "X")
+    label = first(ins, "Label")
+    if isinstance(x, SeqTensor):
+        x = x.data
+    if isinstance(label, SeqTensor):
+        label = label.data
+    w, b = first(ins, "W"), first(ins, "Bias")
+    nc = int(attrs["num_classes"])
+    B = x.shape[0]
+    label = label.reshape(B).astype(jnp.int32)
+
+    depth = int(np.ceil(np.log2(nc))) + 1
+    code = label + nc                                   # heap leaf, root=1
+    # level d: node = code >> d (d=1..depth); internal node idx = node//1 - ...
+    ds = jnp.arange(1, depth + 1)
+    nodes = code[:, None] >> ds[None, :]                # [B,depth] ancestors
+    bits = (code[:, None] >> (ds[None, :] - 1)) & 1     # child direction
+    valid = nodes >= 1
+    w_idx = jnp.clip(nodes - 1, 0, nc - 2)              # W row per node
+    zv = jnp.einsum("bd,bkd->bk", x.astype(jnp.float32),
+                    w[w_idx].astype(jnp.float32))
+    if b is not None:
+        zv = zv + b[w_idx, 0].astype(jnp.float32)
+    # every ancestor down to the root (node 1, W row 0) is a decision node;
+    # node 0 means the path ended above this level
+    # P(label) = prod sigma((1-2bit) z); NLL sum of softplus terms
+    sgn = 1.0 - 2.0 * bits.astype(jnp.float32)
+    terms = jax.nn.softplus(-sgn * zv) * valid.astype(jnp.float32)
+    loss = terms.sum(axis=1)[:, None]
+    return out(Out=loss.astype(x.dtype),
+               PreOut=zv.astype(x.dtype))
+
+
+set_stop_gradient_outputs("hierarchical_sigmoid", ["PreOut"])

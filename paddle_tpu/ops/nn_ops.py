@@ -24,7 +24,11 @@ from .util import first, many, out
 
 
 def _pref(x):
-    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    # bf16 needs no explicit fp32 accumulation hint: the TPU MXU accumulates
+    # bf16 products in fp32 natively, and an explicit preferred_element_type
+    # breaks jax's conv/dot transpose rule under AMP (fp32 cotangent meets
+    # bf16 operand in the transposed conv). Keep the hint only for fp16.
+    return jnp.float32 if x.dtype == jnp.float16 else None
 
 
 # ---------------------------------------------------------------------------

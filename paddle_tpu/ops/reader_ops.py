@@ -191,12 +191,21 @@ class DoubleBufferReader(ReaderBase):
 
         self._q = q
         self._stop_evt = stop
+        self._exhausted = False
         self._t = threading.Thread(target=work, daemon=True)
         self._t.start()
 
     def read_next(self):
+        # Once EOF is seen, every further read returns None until reset()
+        # (the reference keeps re-raising EOF until ReInit); without this a
+        # second post-EOF read would block forever on the drained queue.
+        if self._exhausted:
+            return None
         s = self._q.get()
-        return None if s is self._END else s
+        if s is self._END:
+            self._exhausted = True
+            return None
+        return s
 
     def reset(self):
         self._stop_evt.set()

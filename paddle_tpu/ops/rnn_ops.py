@@ -233,12 +233,31 @@ def attention_lstm_decoder_op(ctx, ins, attrs):
     b_out = first(ins, "BOut")
 
     d = boot.shape[-1]
+
+    def _check_cap(lengths, cap, what):
+        # A sequence longer than the static scan bound would be silently
+        # truncated (wrong loss, no error). Catch it whenever lengths are
+        # concrete; under jit the cap is a static bound the caller vouches
+        # for (the eager first run of a program catches bad data).
+        try:
+            mx = int(jnp.max(lengths))
+        except Exception:
+            return
+        if mx > cap:
+            raise ValueError(
+                f"attention_lstm_decoder: {what} sequence of length {mx} "
+                f"exceeds static cap {cap}; raise max_{what}_len")
+
     Tt = attrs.get("max_target_len", -1)
     if Tt is None or Tt < 0:
         Tt = int(temb.ntokens)
+    else:
+        _check_cap(temb.lengths, Tt, "target")
     Ts = attrs.get("max_source_len", -1)
     if Ts is None or Ts < 0:
         Ts = int(evec.ntokens)
+    else:
+        _check_cap(evec.lengths, Ts, "source")
 
     tp = seq_to_padded(temb, Tt)            # [B,Tt,E]
     ep = seq_to_padded(evec, Ts)            # [B,Ts,He]
@@ -285,3 +304,45 @@ def attention_lstm_decoder_op(ctx, ins, attrs):
     (_, _), ps = lax.scan(step, (h0, c0), (xs, ts))       # [Tt,B,V]
     pred = jnp.swapaxes(ps, 0, 1)                         # [B,Tt,V]
     return out(Out=padded_to_seq(pred, tgt_len, temb.ntokens))
+
+
+@register_op("attention_lstm_step", lod_aware=True)
+def attention_lstm_step_op(ctx, ins, attrs):
+    """ONE decoder step on dense beam rows — the inference-time counterpart
+    of attention_lstm_decoder (reference: the DynamicRNN decoder unrolled by
+    the While op in test_machine_translation.py inference; here the host
+    drives the loop and this op + beam_search do each step on device).
+
+    PrevEmb [N,E], PrevH/PrevC [N,D], EncoderVec [N,Ts,He],
+    EncoderProj [N,Ts,D], SrcMask [N,Ts] -> H, C, LogProbs [N,V].
+    N = B*beam_size rows (source-major)."""
+    x = first(ins, "PrevEmb")
+    h_prev, c_prev = first(ins, "PrevH"), first(ins, "PrevC")
+    ep = first(ins, "EncoderVec")
+    pp = first(ins, "EncoderProj")
+    src_mask = first(ins, "SrcMask")
+    w_att_state = first(ins, "WAttState")
+    w_att_score = first(ins, "WAttScore")
+    w_step = first(ins, "WStep")
+    b_step = first(ins, "BStep")
+    w_out = first(ins, "WOut")
+    b_out = first(ins, "BOut")
+
+    sp = _mm(h_prev, w_att_state)
+    cat = jnp.concatenate(
+        [pp, jnp.broadcast_to(sp[:, None, :], pp.shape)], axis=-1)
+    scores = jnp.tanh(jnp.einsum("bsd,dk->bsk", cat, w_att_score))[..., 0]
+    scores = jnp.where(src_mask > 0, scores, -1e9)
+    w = jax.nn.softmax(scores, axis=-1) * src_mask
+    w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-9)
+    context = jnp.einsum("bs,bsh->bh", w, ep)
+
+    dec_in = jnp.concatenate([h_prev, context, x], axis=-1)
+    gates = _mm(dec_in, w_step) + b_step
+    i_g, f_g, c_g, o_g = jnp.split(gates, 4, axis=-1)
+    c_new = (jax.nn.sigmoid(f_g) * c_prev +
+             jax.nn.sigmoid(i_g) * jnp.tanh(c_g))
+    h_new = jax.nn.sigmoid(o_g) * jnp.tanh(c_new)
+    logits = _mm(h_new, w_out) + b_out
+    return out(H=h_new, C=c_new,
+               LogProbs=jax.nn.log_softmax(logits, axis=-1))

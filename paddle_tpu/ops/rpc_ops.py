@@ -84,12 +84,16 @@ def fetch_barrier_op(ctx, ins, attrs):
 @register_op("send", no_trace=True, lod_aware=True)
 def send_op(ctx, ins, attrs):
     """combined send grads + barrier + fetch params (reference send_op.cc:29,
-    used by layers.Send)."""
+    used by layers.Send). Supports the same `send_as` wire-name attr as
+    send_vars so sync multi-trainer pservers (which aggregate over
+    `<grad>.trainer_N` buffers) see distinct per-trainer vars instead of
+    trainers overwriting one scope slot."""
     op = ctx.current_op
     names = op.input("X")
     epmap = attrs["epmap"]
-    for name, ep in zip(names, epmap):
-        _client(ep).send_var(name, _resolve_value(ctx, name))
+    wire_names = attrs.get("send_as") or names
+    for name, wire, ep in zip(names, wire_names, epmap):
+        _client(ep).send_var(wire, _resolve_value(ctx, name))
     for ep in sorted(set(epmap)):
         _client(ep).batch_barrier()
     out_names = op.output("Out")

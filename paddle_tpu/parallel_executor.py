@@ -22,6 +22,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import amp
 from .core import executor_core
 from .core.framework import Parameter, Variable, default_main_program
 from .core.lod_tensor import LoDTensor
@@ -150,6 +151,7 @@ class ParallelExecutor:
             tuple(sorted((n, executor_core.spec_of(v)) for n, v in feed_vals.items())),
             tuple(fetch_names),
             tuple(state_names),
+            amp.fingerprint(),
         )
         entry = self._compile_cache.get(cache_key)
         if entry is None:

@@ -4,6 +4,10 @@ Reference parity: paddle/fluid/recordio/ (writer/scanner) +
 python/paddle/fluid/recordio_writer.py (convert_reader_to_recordio_file).
 Records are arbitrary byte strings; the fluid-style tensor convention
 pickles a tuple of (numpy array, lod) per slot.
+
+API parity only: the on-disk chunk layout (see native/recordio.cc) is NOT
+the reference's container format, so files are not interchangeable with the
+reference toolchain.
 """
 
 import ctypes

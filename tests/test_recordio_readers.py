@@ -78,6 +78,58 @@ def test_reader_pipeline_trains(tmp_path):
     assert np.isfinite(losses).all()
 
 
+def test_recordio_huge_stored_len_header(tmp_path):
+    """ADVICE r1: a corrupt chunk header claiming a huge stored_len must end
+    the scan cleanly, not abort the process via bad_alloc across the C ABI."""
+    import struct
+
+    path = str(tmp_path / "huge.rio")
+    with recordio.Writer(path, max_num_records=10) as w:
+        for i in range(20):
+            w.write(pickle.dumps(i))
+    raw = bytearray(open(path, "rb").read())
+    # Chunk header: magic(4) n(4) codec(4) raw_len(8) stored_len(8) crc(4).
+    # Forge the SECOND chunk's stored_len to ~2^62 (first chunk starts at 0;
+    # its total size = 32 + stored_len of chunk 1).
+    stored1 = struct.unpack_from("<Q", raw, 20)[0]
+    off2 = 32 + stored1
+    assert raw[off2:off2 + 4] == b"RIOC"
+    struct.pack_into("<Q", raw, off2 + 20, 1 << 62)
+    with open(path, "wb") as f:
+        f.write(raw)
+    got = [pickle.loads(r) for r in recordio.Scanner(path)]
+    assert got == list(range(10))  # first chunk intact, scan ends cleanly
+
+
+def test_double_buffer_post_eof_reads(tmp_path):
+    """ADVICE r1: every post-EOF read_next() must return None (not block)
+    until reset(); reference double-buffer keeps re-raising EOF until
+    ReInit."""
+    from paddle_tpu.ops.reader_ops import DoubleBufferReader, ReaderBase
+
+    class CountReader(ReaderBase):
+        def __init__(self, n):
+            self.n = n
+            self.i = 0
+
+        def read_next(self):
+            if self.i >= self.n:
+                return None
+            self.i += 1
+            return [(np.array([self.i], dtype="float32"), None)]
+
+        def reset(self):
+            self.i = 0
+
+    r = DoubleBufferReader(CountReader(3))
+    got = [r.read_next() for _ in range(3)]
+    assert all(g is not None for g in got)
+    for _ in range(5):  # must not hang
+        assert r.read_next() is None
+    r.reset()
+    assert r.read_next() is not None
+
+
 def test_convert_reader_to_recordio(tmp_path):
     path = str(tmp_path / "conv.rio")
     def reader():
