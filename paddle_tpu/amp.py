@@ -149,6 +149,10 @@ def _cast_value(v, target, only_from=None):
     if isinstance(v, SeqTensor):
         d = _cast_value(v.data, target, only_from)
         return v if d is v.data else SeqTensor(d, v.lengths)
+    from .core.selected_rows import SelectedRows
+    if isinstance(v, SelectedRows):
+        d = _cast_value(v.values, target, only_from)
+        return v if d is v.values else SelectedRows(v.rows, d, v.height)
     if not hasattr(v, "dtype"):
         return v
     kind = np.dtype(v.dtype) if not isinstance(v.dtype, np.dtype) else v.dtype

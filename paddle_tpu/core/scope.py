@@ -63,16 +63,37 @@ class Scope:
         return v
 
 
+import threading
+
 _global_scope = Scope()
-_scope_stack = [_global_scope]
+_tls = threading.local()
+
+
+def _stack():
+    """Per-THREAD scope stack. A fresh thread starts at the process-wide
+    global scope, so one thread's scope_guard (e.g. a pserver serving from
+    its own scope) never redirects another thread's global_scope() — the
+    reference gets the same isolation by passing Scope& explicitly."""
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = [_global_scope]
+    return st
 
 
 def global_scope():
-    return _scope_stack[-1]
+    return _stack()[-1]
+
+
+def reset_global_scope(scope=None):
+    """Replace the process-wide global scope (test isolation)."""
+    global _global_scope
+    _global_scope = scope if scope is not None else Scope()
+    _tls.stack = [_global_scope]
+    return _global_scope
 
 
 def _switch_scope(scope):
-    _scope_stack.append(scope)
+    _stack().append(scope)
     return scope
 
 
@@ -81,10 +102,11 @@ def scope_guard(scope):
 
     @contextlib.contextmanager
     def _guard():
-        _scope_stack.append(scope)
+        st = _stack()
+        st.append(scope)
         try:
             yield
         finally:
-            _scope_stack.pop()
+            st.pop()
 
     return _guard()

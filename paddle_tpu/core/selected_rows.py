@@ -1,0 +1,166 @@
+"""SelectedRows: the sparse row-set tensor type.
+
+Reference parity: paddle/fluid/framework/selected_rows.h:26 (rows_ +
+value_ + height_) and its kernels (operators/math/selected_rows_functor.cc:
+merge_add, scatter update paths).
+
+Two representations:
+
+- `SelectedRows` — (rows, values, height). `rows` may contain duplicates
+  (like the reference); consumers merge. Registered as a jax pytree so a
+  sparse gradient can flow THROUGH a jit trace as a pair of static-shape
+  arrays (ids + grad rows) — the TPU-idiomatic form of a sparse update:
+  the optimizer does one scatter-add instead of materializing a dense
+  [vocab, dim] gradient.
+
+- `SparseTable` — the parameter-server side auto-growing hash table
+  (reference lookup_sparse_table_op.cc AutoGrownIndex + framework
+  selected_rows.h Get/Set). Host-only, numpy-backed, keyed by raw id so a
+  mod-sharded pserver never rebases indices. Rows are initialized on first
+  touch with a deterministic per-id uniform draw, so recovery/re-shard
+  reproduces the same init.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows", "SparseTable", "merge_selected_rows"]
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """rows: int [R]; values: [R, ...dim]; height: logical dim-0 size."""
+
+    def __init__(self, rows, values, height):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, values = children
+        return cls(rows, values, height)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_dense(self):
+        """Scatter-add into a dense [height, ...dim] array (duplicates sum)."""
+        v = jnp.asarray(self.values)
+        dense = jnp.zeros((self.height,) + v.shape[1:], v.dtype)
+        return dense.at[jnp.asarray(self.rows)].add(v)
+
+    def __repr__(self):
+        return (f"SelectedRows(rows={np.shape(self.rows)}, "
+                f"values={np.shape(self.values)}, height={self.height})")
+
+
+def merge_selected_rows(sr):
+    """Host-side duplicate-row merge (reference
+    math::scatter::MergeAdd) -> SelectedRows with unique, sorted rows."""
+    rows = np.asarray(sr.rows).reshape(-1)
+    values = np.asarray(sr.values).reshape(rows.shape[0], -1)
+    uniq, inv = np.unique(rows, return_inverse=True)
+    merged = np.zeros((uniq.shape[0], values.shape[1]), values.dtype)
+    np.add.at(merged, inv, values)
+    merged = merged.reshape((uniq.shape[0],) + tuple(np.shape(sr.values)[1:]))
+    return SelectedRows(uniq, merged, sr.height)
+
+
+class SparseTable:
+    """Auto-growing embedding table for the pserver path.
+
+    reference lookup_sparse_table_op.cc (auto_grown gather with uniform
+    init between min/max) + the distributed table's sgd update
+    (distribute_transpiler.py _create_table_optimize_block).
+    """
+
+    def __init__(self, value_dim, height=None, dtype="float32",
+                 init_low=-0.05, init_high=0.05, seed=0):
+        self.value_dim = int(value_dim)
+        self.height = height  # logical vocab size (None = unbounded)
+        self.dtype = np.dtype(dtype)
+        self.init_low = float(init_low)
+        self.init_high = float(init_high)
+        self.seed = int(seed)
+        self._index = {}           # id -> row in _data
+        self._data = np.zeros((0, self.value_dim), self.dtype)
+
+    def __len__(self):
+        return len(self._index)
+
+    def rows(self):
+        """Known ids, in insertion order."""
+        return np.fromiter(self._index.keys(), dtype=np.int64,
+                           count=len(self._index))
+
+    def _init_row(self, id_):
+        rng = np.random.RandomState((self.seed * 0x9E3779B1 + int(id_))
+                                    & 0x7FFFFFFF)
+        return rng.uniform(self.init_low, self.init_high,
+                           self.value_dim).astype(self.dtype)
+
+    def _grow(self, ids):
+        new = [i for i in ids if i not in self._index]
+        if not new:
+            return
+        block = np.stack([self._init_row(i) for i in new])
+        base = self._data.shape[0]
+        self._data = np.concatenate([self._data, block], axis=0)
+        for k, i in enumerate(new):
+            self._index[int(i)] = base + k
+
+    def gather(self, ids, auto_grow=True):
+        """rows for `ids` [N] -> [N, value_dim]; unknown ids are initialized
+        (auto_grow) or returned as zeros."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        if self.height is not None and ids.size and \
+                (ids.min() < 0 or ids.max() >= self.height):
+            raise IndexError(
+                f"sparse-table id out of range [0, {self.height}): "
+                f"{ids.min()}..{ids.max()}")
+        if auto_grow:
+            self._grow(ids.tolist())
+            idx = np.fromiter((self._index[int(i)] for i in ids),
+                              dtype=np.int64, count=ids.size)
+            return self._data[idx]
+        outv = np.zeros((ids.size, self.value_dim), self.dtype)
+        for k, i in enumerate(ids):
+            j = self._index.get(int(i))
+            if j is not None:
+                outv[k] = self._data[j]
+        return outv
+
+    def scatter_sub(self, rows, deltas):
+        """param[rows] -= deltas (rows must be unique; grow-on-miss)."""
+        rows = np.asarray(rows).reshape(-1).astype(np.int64)
+        deltas = np.asarray(deltas, self.dtype).reshape(rows.size,
+                                                        self.value_dim)
+        self._grow(rows.tolist())
+        idx = np.fromiter((self._index[int(i)] for i in rows),
+                          dtype=np.int64, count=rows.size)
+        np.subtract.at(self._data, idx, deltas)
+
+    def sgd_update(self, grad, lr):
+        """Apply one SGD step from a SelectedRows gradient."""
+        m = merge_selected_rows(grad)
+        self.scatter_sub(m.rows, np.asarray(m.values) * float(lr))
+
+    def to_dense(self, height=None):
+        """Dense [height, value_dim] snapshot; untouched ids get their
+        deterministic init (so dense/sparse paths agree on never-seen ids
+        only if the consumer also auto-grows — untouched rows here are 0)."""
+        height = height if height is not None else self.height
+        if height is None:
+            height = (max(self._index) + 1) if self._index else 0
+        dense = np.zeros((int(height), self.value_dim), self.dtype)
+        for i, j in self._index.items():
+            if 0 <= i < height:
+                dense[i] = self._data[j]
+        return dense

@@ -86,8 +86,24 @@ for _name, _fn in [
 
 @register_op("sum")
 def sum_op(ctx, ins, attrs):
-    """reference operators/sum_op.cc — add N tensors (grad accumulation)."""
+    """reference operators/sum_op.cc — add N tensors (grad accumulation).
+
+    SelectedRows inputs (sparse gradients) follow the reference's
+    SelectedRowsAddTo path: all-sparse stays sparse (rows concatenated,
+    duplicates left for the consumer to merge); a dense/sparse mix densifies."""
     xs = many(ins, "X")
+    from ..core.selected_rows import SelectedRows
+
+    if any(isinstance(x, SelectedRows) for x in xs):
+        if all(isinstance(x, SelectedRows) for x in xs):
+            rows = jnp.concatenate([jnp.asarray(x.rows).reshape(-1) for x in xs])
+            vals = jnp.concatenate([jnp.asarray(x.values) for x in xs])
+            return out(Out=SelectedRows(rows, vals, xs[0].height))
+        acc = None
+        for x in xs:
+            d = x.to_dense() if isinstance(x, SelectedRows) else x
+            acc = d if acc is None else acc + d
+        return out(Out=acc)
     acc = xs[0]
     for x in xs[1:]:
         acc = acc + x
@@ -100,6 +116,12 @@ def scale_op(ctx, ins, attrs):
     s = attrs.get("scale", 1.0)
     b = attrs.get("bias", 0.0)
     after = attrs.get("bias_after_scale", True)
+    from ..core.selected_rows import SelectedRows
+
+    if isinstance(x, SelectedRows):  # sparse grad scaling (pserver path)
+        assert b == 0.0, "scale with bias is undefined on SelectedRows"
+        v = jnp.asarray(x.values)
+        return out(Out=SelectedRows(x.rows, (v * s).astype(v.dtype), x.height))
     o = x * s + b if after else (x + b) * s
     return out(Out=o.astype(x.dtype))
 

@@ -14,7 +14,23 @@ from .util import first, out
 
 @register_op("sgd")
 def sgd_op(ctx, ins, attrs):
+    """reference operators/sgd_op.cc: dense update, plus its two sparse
+    paths — SelectedRows grad on a dense param (scatter-sub; the
+    TPU-idiomatic in-trace form of a sparse embedding update) and
+    SelectedRows grad on a pserver SparseTable (host hash-table update)."""
     p, g, lr = first(ins, "Param"), first(ins, "Grad"), first(ins, "LearningRate")
+    from ..core.selected_rows import SelectedRows, SparseTable
+
+    if isinstance(p, SparseTable):
+        assert isinstance(g, SelectedRows), \
+            f"SparseTable sgd needs a SelectedRows grad, got {type(g)}"
+        p.sgd_update(g, float(jnp.asarray(lr).reshape(())))
+        return out(ParamOut=p)
+    if isinstance(g, SelectedRows):
+        lr = jnp.asarray(lr).reshape(()).astype(p.dtype)
+        upd = jnp.asarray(p).at[jnp.asarray(g.rows).reshape(-1)].add(
+            -lr * jnp.asarray(g.values).astype(p.dtype))
+        return out(ParamOut=upd)
     return out(ParamOut=(p - lr.reshape(()).astype(p.dtype) * g.astype(p.dtype)))
 
 

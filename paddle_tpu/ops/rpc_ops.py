@@ -154,12 +154,32 @@ def listen_and_serv_op(ctx, ins, attrs):
         if block is not None:
             exe.run_block_eager(block, scope)
 
+    # distributed lookup table: serve prefetch requests by running the
+    # transpiler-built prefetch block (lookup_sparse_table over the local
+    # table shard) — reference listen_and_serv_op.cc prefetch_block
+    prefetch_block = attrs.get("PrefetchBlock")
+    pf_in = attrs.get("prefetch_in_name")
+    pf_out = attrs.get("prefetch_out_name")
+
+    def on_prefetch(table_name, ids):
+        if prefetch_block is None:
+            raise KeyError(f"no prefetch block for table {table_name!r}")
+        scope.var(pf_in)
+        scope.set_var(pf_in, np.asarray(ids).reshape(-1, 1))
+        # seed the output slot so run_block_eager's write-back (which only
+        # touches persistable-or-existing scope vars) includes it
+        scope.var(pf_out)
+        scope.set_var(pf_out, np.zeros((0,), np.float32))
+        exe.run_block_eager(prefetch_block, scope)
+        return scope.find_var(pf_out)
+
     host = endpoint.rsplit(":", 1)[0] if ":" in endpoint else "127.0.0.1"
     port = endpoint.rsplit(":", 1)[1] if ":" in endpoint else "0"
     server = rpc_runtime.VariableServer(
         bind=f"{host}:{port}", num_trainers=fan_in, get_var=get_var,
         put_var=put_var, on_round=on_round, sync_mode=sync_mode,
-        on_grad=on_grad)
+        on_grad=on_grad,
+        on_prefetch=on_prefetch if prefetch_block is not None else None)
     server.save_port()
     server.serve_forever()
     return {}

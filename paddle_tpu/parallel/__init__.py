@@ -12,11 +12,14 @@ from . import mesh
 from . import distributed
 from . import rpc
 from . import ring
+from . import sharded_embedding
 from .mesh import make_mesh, data_parallel_mesh, mesh_scope
 from .ring import ring_attention, ring_attention_sharded
+from .sharded_embedding import shard_table, sharded_embedding_lookup
 
 __all__ = [
-    "mesh", "distributed", "rpc", "ring",
+    "mesh", "distributed", "rpc", "ring", "sharded_embedding",
     "make_mesh", "data_parallel_mesh", "mesh_scope",
     "ring_attention", "ring_attention_sharded",
+    "shard_table", "sharded_embedding_lookup",
 ]

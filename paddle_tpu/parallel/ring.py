@@ -103,12 +103,10 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
     """Full-tensor entry: q,k,v [B,H,S,D] sharded (or shardable) on S over
     mesh axis `axis_name`. Returns attention output with the same sharding.
     """
-    from jax.experimental.shard_map import shard_map
-
     spec = P(None, None, axis_name, None)
-    fn = shard_map(
+    fn = jax.shard_map(
         functools.partial(ring_attention_sharded, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        check_vma=False)
     return fn(q, k, v)

@@ -64,10 +64,19 @@ def _recv_msg(sock):
 
 
 def serialize_var(value):
-    """LoDTensor / numpy / jax array -> wire dict (send_recv.proto
-    VariableMessage: dims, lod, serialized bytes)."""
+    """LoDTensor / SelectedRows / numpy / jax array -> wire dict
+    (send_recv.proto VariableMessage: dims, lod, serialized bytes; the
+    SelectedRows kind carries rows + height like the reference's
+    SelectedRows message)."""
     from ..core.lod_tensor import LoDTensor
+    from ..core.selected_rows import SelectedRows
 
+    if isinstance(value, SelectedRows):
+        rows = np.asarray(value.rows).reshape(-1).astype(np.int64)
+        vals = np.asarray(value.values)
+        return {"kind": "selected_rows", "data": vals.tobytes(),
+                "dtype": str(vals.dtype), "shape": vals.shape,
+                "rows": rows.tobytes(), "height": value.height, "lod": []}
     if isinstance(value, LoDTensor):
         arr = np.asarray(value.numpy())
         return {"kind": "lod_tensor", "data": arr.tobytes(),
@@ -80,9 +89,13 @@ def serialize_var(value):
 
 def deserialize_var(msg):
     from ..core.lod_tensor import LoDTensor
+    from ..core.selected_rows import SelectedRows
 
     arr = np.frombuffer(
         msg["data"], dtype=np.dtype(msg["dtype"])).reshape(msg["shape"])
+    if msg["kind"] == "selected_rows":
+        rows = np.frombuffer(msg["rows"], dtype=np.int64)
+        return SelectedRows(rows.copy(), arr.copy(), msg["height"])
     if msg["kind"] == "lod_tensor" and msg["lod"]:
         return LoDTensor(arr.copy(), msg["lod"])
     return arr.copy()
@@ -112,6 +125,16 @@ class VariableClient:
             raise RpcError(f"get_var({name}) failed: {payload}")
         return deserialize_var(payload)
 
+    def prefetch(self, table_name, ids):
+        """reference grpc_client.h AsyncPrefetchVariable: send lookup ids,
+        receive the table rows (served by the pserver's prefetch block)."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        _send_msg(self._sock, ("prefetch", table_name, serialize_var(ids)))
+        resp = _recv_msg(self._sock)
+        if resp[0] == "err":
+            raise RpcError(f"prefetch({table_name}) failed: {resp[1]}")
+        return deserialize_var(resp[1])
+
     def batch_barrier(self):
         """reference BATCH_BARRIER_MESSAGE after grads sent."""
         _send_msg(self._sock, ("batch_barrier",))
@@ -140,7 +163,8 @@ class VariableServer:
     (sync semantics)."""
 
     def __init__(self, bind="127.0.0.1:0", num_trainers=1, get_var=None,
-                 put_var=None, on_round=None, sync_mode=True, on_grad=None):
+                 put_var=None, on_round=None, sync_mode=True, on_grad=None,
+                 on_prefetch=None):
         host, port = bind.rsplit(":", 1)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -153,6 +177,7 @@ class VariableServer:
         self._put_var = put_var
         self._on_round = on_round
         self._on_grad = on_grad  # async mode: per-grad update callback
+        self._on_prefetch = on_prefetch  # (table_name, ids) -> rows
         self._lock = threading.Condition()
         self._batch_count = 0
         self._fetch_count = 0
@@ -236,6 +261,18 @@ class VariableServer:
                         _send_msg(conn, ("var", serialize_var(value)))
                     except KeyError as e:
                         _send_msg(conn, ("err", str(e)))
+                elif op == "prefetch":
+                    # served at any time (reference prefetch runs outside
+                    # the sync round: lookups are read-mostly and the table
+                    # grows on first touch)
+                    _, table_name, payload = msg
+                    if self._on_prefetch is None:
+                        _send_msg(conn, ("err", "no prefetch handler"))
+                    else:
+                        ids = deserialize_var(payload)
+                        with self._lock:
+                            rows = self._on_prefetch(table_name, ids)
+                        _send_msg(conn, ("rows", serialize_var(rows)))
                 elif op == "fetch_barrier":
                     self._handle_fetch_barrier()
                     _send_msg(conn, ("ok",))

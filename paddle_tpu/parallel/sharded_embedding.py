@@ -1,0 +1,59 @@
+"""Mesh-sharded embedding: the collective counterpart of the pserver
+distributed lookup table.
+
+Reference contrast: the reference's only sharded-embedding path is the
+pserver prefetch RPC (distribute_transpiler.py:624, operators/prefetch_op.cc)
+— host round-trips per lookup. On TPU the idiomatic form keeps the table
+row-sharded across the mesh in HBM and resolves lookups with one psum over
+ICI: every device gathers the ids that fall in its row range (masked local
+gather) and the psum assembles full rows everywhere. The gradient is the
+transpose (masked local scatter-add), which jax derives automatically, so a
+training step over a sharded table needs no hand-written backward.
+
+All functions are shard_map-based and jit/pjit compatible.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["shard_table", "sharded_embedding_lookup"]
+
+
+def shard_table(table, mesh, axis="mp"):
+    """Place a [V, D] table row-sharded over mesh axis `axis` (V must divide
+    evenly; pad the vocab up like every TP implementation does)."""
+    nshards = mesh.shape[axis]
+    assert table.shape[0] % nshards == 0, (
+        f"vocab {table.shape[0]} not divisible by {nshards} shards; pad it")
+    return jax.device_put(
+        table, jax.sharding.NamedSharding(mesh, P(axis, None)))
+
+
+def _local_lookup(table_shard, ids, axis, nshards, vocab):
+    rows_per = vocab // nshards
+    start = jax.lax.axis_index(axis) * rows_per
+    local = ids - start
+    ok = (local >= 0) & (local < rows_per)
+    rows = jnp.take(table_shard, jnp.clip(local, 0, rows_per - 1), axis=0)
+    rows = jnp.where(ok[..., None], rows, jnp.zeros((), rows.dtype))
+    return jax.lax.psum(rows, axis)
+
+
+def sharded_embedding_lookup(table, ids, mesh, axis="mp"):
+    """ids [...] int -> rows [..., D]; `table` [V, D] sharded on rows over
+    `axis` (see shard_table). Exact match with jnp.take on the unsharded
+    table; differentiable through the table operand."""
+    nshards = mesh.shape[axis]
+    vocab = table.shape[0]
+    fn = jax.shard_map(
+        functools.partial(_local_lookup, axis=axis, nshards=nshards,
+                          vocab=vocab),
+        mesh=mesh,
+        in_specs=(P(axis, None), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return fn(table, ids)

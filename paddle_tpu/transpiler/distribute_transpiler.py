@@ -92,6 +92,16 @@ class DistributeTranspiler:
         pserver_endpoints = pservers.split(",")
         self.pserver_endpoints = pserver_endpoints
         self.optimize_ops, self.params_grads = self._get_optimize_pass()
+        # distributed lookup table (reference :169 — the EP-precursor):
+        # the table param leaves the dense split/send/recv path entirely;
+        # lookups become prefetch RPCs and its gradient rides as
+        # mod-sharded SelectedRows
+        self.table_name = self._find_distributed_table(program)
+        if self.table_name:
+            self.params_grads = [
+                pg for pg in self.params_grads
+                if pg[0].name != self.table_name
+            ]
         ps_dispatcher = split_method(self.pserver_endpoints)
 
         # split params/grads into blocks
@@ -225,6 +235,186 @@ class DistributeTranspiler:
 
         self._delete_trainer_optimize_ops(block)
 
+        if self.table_name:
+            self._replace_lookup_table_op_with_prefetch(
+                program, pserver_endpoints)
+            self._split_table_grad_and_add_send_vars(
+                program, pserver_endpoints)
+
+    # ------------------------------------------------------------------
+    # distributed lookup table (reference :624-822)
+    # ------------------------------------------------------------------
+    def _find_distributed_table(self, program):
+        """reference :169: at most one lookup_table with is_distributed."""
+        dist_ops = [
+            op for op in program.global_block().ops
+            if op.type == LOOKUP_TABLE_TYPE
+            and op.attrs.get("is_distributed", False)
+        ]
+        names = {op.input("W")[0] for op in dist_ops}
+        assert len(names) <= 1, (
+            "all distributed lookup_table ops must share one table; got "
+            f"{sorted(names)}")
+        # the table gradient must ride as SelectedRows (split_ids mod-shards
+        # its rows); a dense grad would be misread as an ids tensor
+        assert all(op.attrs.get("is_sparse", False) for op in dist_ops), (
+            "is_distributed=True requires is_sparse=True on the embedding")
+        return names.pop() if names else None
+
+    def _replace_lookup_table_op_with_prefetch(self, program, eplist):
+        """reference :624 — swap every distributed lookup_table for
+        split_ids -> prefetch -> merge_ids (merge_ids rather than the
+        reference-era concat: mod-sharded ids come back out of order)."""
+        block = program.global_block()
+        n = len(eplist)
+        table_var = block.vars[self.table_name]
+        emb_dim = int(table_var.shape[1])
+        self.prefetch_input_vars = [
+            block.create_var(name=f"{self.table_name}.prefetch_in_{i}",
+                             dtype="int64", shape=(-1, 1))
+            for i in range(n)
+        ]
+        self.prefetch_output_vars = [
+            block.create_var(name=f"{self.table_name}.prefetch_out_{i}",
+                             dtype=table_var.dtype, shape=(-1, emb_dim))
+            for i in range(n)
+        ]
+        while True:
+            idx = next(
+                (i for i, op in enumerate(block.ops)
+                 if op.type == LOOKUP_TABLE_TYPE
+                 and op.input("W")[0] == self.table_name),
+                None,
+            )
+            if idx is None:
+                break
+            op = block.ops[idx]
+            ids_var = block.vars[op.input("Ids")[0]]
+            out_var = block.vars[op.output("Out")[0]]
+            del block.ops[idx]
+            block.insert_op(
+                idx, "split_ids",
+                {"Ids": [ids_var]}, {"Out": self.prefetch_input_vars}, {})
+            block.insert_op(
+                idx + 1, "prefetch",
+                {"X": self.prefetch_input_vars},
+                {"Out": self.prefetch_output_vars},
+                {"epmap": list(eplist), "table_name": self.table_name,
+                 "emb_dim": emb_dim, "dtype": table_var.dtype,
+                 OP_ROLE_ATTR_NAME: RPC_OP_ROLE_ATTR_VALUE},
+            )
+            block.insert_op(
+                idx + 2, "merge_ids",
+                {"Ids": [ids_var], "X": self.prefetch_input_vars,
+                 "Rows": self.prefetch_output_vars},
+                {"Out": [out_var]}, {},
+            )
+        block.program._mutation += 1
+
+    def _split_table_grad_and_add_send_vars(self, program, pserver_endpoints):
+        """reference :695 — after the op producing the table's SelectedRows
+        gradient, mod-shard it and send one shard to each pserver."""
+        block = program.global_block()
+        grad_name = f"{self.table_name}@GRAD"
+        # anchor on the LAST writer: with several lookups of one table the
+        # earlier writers are partial contributions that a trailing sum op
+        # accumulates into the canonical grad
+        idxs = [i for i, op in enumerate(block.ops)
+                if grad_name in op.output_arg_names()]
+        if not idxs:
+            return  # inference-only program: no table gradient
+        idx = idxs[-1]
+        grad_var = block.vars.get(grad_name) or block.create_var(
+            name=grad_name, dtype="float32", shape=(-1,))
+        self.table_grad_list = [
+            block.create_var(name=f"{grad_name}.block_{i}",
+                             dtype="float32", shape=(-1,))
+            for i in range(len(pserver_endpoints))
+        ]
+        if self.sync_mode and self.trainer_num > 1:
+            send_as = [f"{v.name}.trainer_{self.trainer_id}"
+                       for v in self.table_grad_list]
+        else:
+            send_as = [v.name for v in self.table_grad_list]
+        block.insert_op(
+            idx + 1, "split_ids",
+            {"Ids": [grad_var]}, {"Out": self.table_grad_list}, {})
+        block.insert_op(
+            idx + 2, "send_vars",
+            {"X": self.table_grad_list}, {"Out": []},
+            {"epmap": list(pserver_endpoints), "send_as": send_as,
+             "sync_send": True,
+             OP_ROLE_ATTR_NAME: RPC_OP_ROLE_ATTR_VALUE},
+        )
+        block.program._mutation += 1
+
+    def _create_prefetch_block(self, pserver_index, pserver_program):
+        """reference :726 — pserver-side block: lookup_sparse_table over the
+        local table shard."""
+        gb = pserver_program.global_block()
+        table_var = gb.vars[self.table_name]
+        pf_in = gb.create_var(name=f"{self.table_name}.prefetch_ids",
+                              dtype="int64", shape=(-1, 1))
+        pf_out = gb.create_var(name=f"{self.table_name}.prefetch_rows",
+                               dtype=table_var.dtype,
+                               shape=(-1, int(self.table_shape[1])))
+        blk = pserver_program.create_block(0)
+        pserver_program.rollback()
+        blk.append_op(
+            "lookup_sparse_table",
+            {"Ids": [pf_in], "W": [table_var]}, {"Out": [pf_out]},
+            {"is_distributed": True, "auto_grown_table": True},
+        )
+        return blk, pf_in.name, pf_out.name
+
+    def _create_table_optimize_block(self, pserver_index, pserver_program,
+                                     table_opt_op):
+        """reference :751 — sum the trainers' SelectedRows table-grad
+        shards (scaled 1/trainers like the dense path), then sparse-sgd into
+        the SparseTable. Only sgd is supported for the table (same
+        restriction as the reference)."""
+        gb = pserver_program.global_block()
+        table_var = gb.vars[self.table_name]
+        assert table_opt_op.type == "sgd", (
+            "distributed lookup table only supports the sgd optimizer "
+            f"(reference restriction); got {table_opt_op.type}")
+        grad_name = f"{self.table_name}@GRAD.block_{pserver_index}"
+        dtype = table_var.dtype
+        blk = pserver_program.create_block(0)
+        pserver_program.rollback()
+        if self.sync_mode and self.trainer_num > 1:
+            trainer_grads = [
+                gb.create_var(name=f"{grad_name}.trainer_{t}",
+                              dtype=dtype, shape=(-1,))
+                for t in range(self.trainer_num)
+            ]
+            merged = blk.create_var(name=grad_name + ".merged",
+                                    dtype=dtype, shape=(-1,))
+            blk.append_op("sum", {"X": trainer_grads}, {"Out": [merged]}, {})
+            scaled = blk.create_var(name=grad_name + ".scaled",
+                                    dtype=dtype, shape=(-1,))
+            blk.append_op("scale", {"X": [merged]}, {"Out": [scaled]},
+                          {"scale": 1.0 / self.trainer_num})
+            grad_in = scaled
+            recv_names = [v.name for v in trainer_grads]
+        else:
+            grad_in = gb.create_var(name=grad_name, dtype=dtype,
+                                    shape=(-1,))
+            recv_names = [grad_name]
+        lr_name = table_opt_op.input("LearningRate")[0]
+        lr_var = gb.vars.get(lr_name)
+        if lr_var is None:
+            orig_lr = self.origin_program.global_block().vars[lr_name]
+            lr_var = self._clone_var(gb, orig_lr)
+        blk.append_op(
+            "sgd",
+            {"Param": [table_var], "Grad": [grad_in],
+             "LearningRate": [lr_var]},
+            {"ParamOut": [table_var]},
+            dict(table_opt_op.attrs),
+        )
+        return blk, recv_names
+
     def _delete_trainer_optimize_ops(self, block):
         block.ops = [
             op
@@ -272,23 +462,58 @@ class DistributeTranspiler:
                     )
             optimize_block_ids.append(per_opt_block)
 
+        grad_to_block_id = [
+            f"{g.name}:{b.idx}"
+            for g, b in zip(
+                self.param_grad_ep_mapping[endpoint]["grads"],
+                optimize_block_ids,
+            )
+        ]
+        attrs = {
+            "OptimizeBlocks": optimize_block_ids,
+            "endpoint": endpoint,
+            "Fanin": self.trainer_num,
+            "sync_mode": self.sync_mode,
+            "grad_to_block_id": grad_to_block_id,
+        }
+        if self.table_name:
+            pserver_index = self.pserver_endpoints.index(endpoint)
+            origin_param = \
+                self.origin_program.global_block().vars[self.table_name]
+            self.table_shape = origin_param.shape
+            pserver_program.global_block().create_var(
+                name=self.table_name, persistable=True,
+                dtype=origin_param.dtype, shape=origin_param.shape)
+            table_opt_ops = [
+                op for op in self.optimize_ops
+                if "Param" in op.inputs
+                and op.input("Param")[0] == self.table_name
+            ]
+            if table_opt_ops:  # frozen/inference tables serve prefetch only
+                table_opt_block, table_recv_names = \
+                    self._create_table_optimize_block(
+                        pserver_index, pserver_program, table_opt_ops[0])
+                optimize_block_ids.append(table_opt_block)
+                for name in table_recv_names:
+                    recv_inputs.append(
+                        pserver_program.global_block().vars[name]
+                        if name in pserver_program.global_block().vars
+                        else pserver_program.global_block().create_var(
+                            name=name, dtype=origin_param.dtype, shape=(-1,)))
+                grad_to_block_id.append(
+                    f"{self.table_name}@GRAD.block_{pserver_index}"
+                    f":{table_opt_block.idx}")
+            prefetch_block, pf_in, pf_out = self._create_prefetch_block(
+                pserver_index, pserver_program)
+            attrs.update(
+                PrefetchBlock=prefetch_block,
+                prefetch_in_name=pf_in,
+                prefetch_out_name=pf_out,
+                table_name=self.table_name,
+            )
+
         pserver_program.global_block().append_op(
-            "listen_and_serv",
-            {"X": recv_inputs},
-            {},
-            {
-                "OptimizeBlocks": optimize_block_ids,
-                "endpoint": endpoint,
-                "Fanin": self.trainer_num,
-                "sync_mode": self.sync_mode,
-                "grad_to_block_id": [
-                    f"{g.name}:{b.idx}"
-                    for g, b in zip(
-                        self.param_grad_ep_mapping[endpoint]["grads"],
-                        optimize_block_ids,
-                    )
-                ],
-            },
+            "listen_and_serv", {"X": recv_inputs}, {}, attrs,
         )
         return pserver_program
 
@@ -382,6 +607,8 @@ class DistributeTranspiler:
             if not out_names:
                 continue
             target = out_names[0]
+            if self.table_name and target == self.table_name:
+                continue  # the table is a SparseTable, not a dense init
             if target in pserver_vars or any(
                 same_or_split_var(p, target) or p == target for p in param_names
             ) or any(
@@ -404,6 +631,18 @@ class DistributeTranspiler:
                     {"Out": [p.name]},
                     {"shape": list(p.shape), "value": 0.0, "dtype": p.dtype},
                 )
+        if self.table_name:
+            # the table shard is an auto-growing SparseTable (rows are
+            # initialized deterministically on first touch), not a dense init
+            tv = s_prog.global_block().create_var(
+                name=self.table_name, persistable=True,
+                dtype="float32", shape=self.table_shape)
+            s_prog.global_block().append_op(
+                "init_sparse_table", {}, {"Out": [tv]},
+                {"value_dim": int(self.table_shape[1]),
+                 "height": int(self.table_shape[0]),
+                 "seed": 0},
+            )
         return s_prog
 
     # ------------------------------------------------------------------

@@ -37,8 +37,7 @@ def _fresh_programs():
 
     framework.switch_main_program(framework.Program())
     framework.switch_startup_program(framework.Program())
-    scope._global_scope = scope.Scope()
-    scope._scope_stack[:] = [scope._global_scope]
+    scope.reset_global_scope()
     fluid.unique_name.switch()
     yield
 

@@ -1,0 +1,419 @@
+"""Distributed (sharded) lookup table + SelectedRows sparse path.
+
+Reference: unittests/test_dist_transpiler.py (table rewrite assertions),
+operators' split_ids/merge_ids/lookup_sparse_table tests, and the
+distributed-table train flow (distribute_transpiler.py:624-822). The
+collective-path test covers parallel/sharded_embedding.py (the TPU-native
+counterpart the reference lacks).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program, program_guard
+from paddle_tpu.core.selected_rows import (SelectedRows, SparseTable,
+                                           merge_selected_rows)
+from paddle_tpu.parallel import rpc
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows / SparseTable unit behavior
+# ---------------------------------------------------------------------------
+def test_selected_rows_to_dense_and_merge():
+    sr = SelectedRows(np.array([1, 3, 1]),
+                      np.array([[1.0, 1.0], [2.0, 2.0], [10.0, 10.0]],
+                               np.float32), height=5)
+    dense = np.asarray(sr.to_dense())
+    np.testing.assert_allclose(dense[1], [11.0, 11.0])
+    np.testing.assert_allclose(dense[3], [2.0, 2.0])
+    assert dense.shape == (5, 2)
+
+    m = merge_selected_rows(sr)
+    np.testing.assert_array_equal(m.rows, [1, 3])
+    np.testing.assert_allclose(m.values, [[11.0, 11.0], [2.0, 2.0]])
+
+
+def test_sparse_table_auto_grow_and_sgd():
+    t = SparseTable(value_dim=4, height=100, seed=7)
+    r1 = t.gather([5, 9, 5])
+    assert r1.shape == (3, 4)
+    np.testing.assert_allclose(r1[0], r1[2])  # same id, same init
+    assert len(t) == 2
+    # deterministic init: a second table reproduces the rows
+    t2 = SparseTable(value_dim=4, height=100, seed=7)
+    np.testing.assert_allclose(t2.gather([9]), r1[1:2])
+    # sgd: only touched rows move
+    g = SelectedRows(np.array([5, 5]),
+                     np.ones((2, 4), np.float32), height=100)
+    before9 = t.gather([9]).copy()
+    t.sgd_update(g, lr=0.5)
+    np.testing.assert_allclose(t.gather([5]), r1[0:1] - 1.0)  # dup rows merged
+    np.testing.assert_allclose(t.gather([9]), before9)
+    with pytest.raises(IndexError):
+        t.gather([120])
+
+
+def test_sparse_table_rpc_serialization():
+    sr = SelectedRows(np.array([2, 7]), np.ones((2, 3), np.float32), height=9)
+    back = rpc.deserialize_var(rpc.serialize_var(sr))
+    assert isinstance(back, SelectedRows) and back.height == 9
+    np.testing.assert_array_equal(back.rows, sr.rows)
+    np.testing.assert_allclose(back.values, sr.values)
+
+
+# ---------------------------------------------------------------------------
+# Op kernels: sparse lookup grad, split/merge ids, sum, sgd
+# ---------------------------------------------------------------------------
+def _run_ops(op_list, env):
+    from paddle_tpu.core import executor_core
+
+    class _Op:
+        def __init__(self, type, inputs, outputs, attrs):
+            self.type, self.inputs, self.outputs, self.attrs = (
+                type, inputs, outputs, attrs)
+
+        def input(self, slot):
+            return self.inputs[slot]
+
+        def output(self, slot):
+            return self.outputs[slot]
+
+        def input_arg_names(self):
+            return [n for ns in self.inputs.values() for n in ns]
+
+        def output_arg_names(self):
+            return [n for ns in self.outputs.values() for n in ns]
+
+    ops = [_Op(*o) for o in op_list]
+    ctx = executor_core.OpContext(eager=True)
+    executor_core.run_ops(ops, env, ctx)
+    return env
+
+
+def test_lookup_table_grad_sparse_kernel():
+    env = {
+        "W": np.zeros((10, 3), np.float32),
+        "Ids": np.array([[1], [4], [1]], np.int64),
+        "dOut": np.arange(9, dtype=np.float32).reshape(3, 3),
+    }
+    _run_ops([("lookup_table_grad",
+               {"Ids": ["Ids"], "W": ["W"], "Out@GRAD": ["dOut"]},
+               {"W@GRAD": ["dW"]},
+               {"is_sparse": True, "padding_idx": -1})], env)
+    dw = env["dW"]
+    assert isinstance(dw, SelectedRows) and dw.height == 10
+    np.testing.assert_array_equal(np.asarray(dw.rows), [1, 4, 1])
+    # dense equivalence
+    dense = np.asarray(dw.to_dense())
+    ref = np.zeros((10, 3), np.float32)
+    np.add.at(ref, [1, 4, 1], env["dOut"])
+    np.testing.assert_allclose(dense, ref)
+
+
+def test_split_merge_ids_roundtrip():
+    ids = np.array([[7], [2], [9], [2], [4]], np.int64)
+    rows = {i: np.full(3, float(i), np.float32) for i in [2, 4, 7, 9]}
+    env = {"Ids": ids}
+    _run_ops([("split_ids", {"Ids": ["Ids"]},
+               {"Out": ["s0", "s1", "s2"]}, {})], env)
+    shards = [np.asarray(env[f"s{i}"]) for i in range(3)]
+    assert sorted(np.concatenate(shards).tolist()) == [2, 4, 7, 9]  # deduped
+    for s, part in enumerate(shards):
+        assert all(int(i) % 3 == s for i in part)
+    # fake the prefetch result per shard, then merge back in id order
+    env.update({f"r{i}": np.stack([rows[int(j)] for j in shards[i]])
+                if len(shards[i]) else np.zeros((0, 3), np.float32)
+                for i in range(3)})
+    _run_ops([("merge_ids",
+               {"Ids": ["Ids"], "X": ["s0", "s1", "s2"],
+                "Rows": ["r0", "r1", "r2"]},
+               {"Out": ["Out"]}, {})], env)
+    got = np.asarray(env["Out"])
+    want = np.stack([rows[int(i)] for i in ids.reshape(-1)])
+    np.testing.assert_allclose(got, want)
+
+
+def test_split_ids_selected_rows():
+    sr = SelectedRows(np.array([3, 4, 6, 3]),
+                      np.arange(8, dtype=np.float32).reshape(4, 2), height=10)
+    env = {"G": sr}
+    _run_ops([("split_ids", {"Ids": ["G"]}, {"Out": ["g0", "g1"]}, {})], env)
+    g0, g1 = env["g0"], env["g1"]
+    np.testing.assert_array_equal(np.asarray(g0.rows), [4, 6])
+    np.testing.assert_array_equal(np.asarray(g1.rows), [3, 3])
+    np.testing.assert_allclose(np.asarray(g1.values),
+                               [[0.0, 1.0], [6.0, 7.0]])
+
+
+def test_sum_and_sgd_selected_rows():
+    a = SelectedRows(np.array([0, 2]), np.ones((2, 2), np.float32), height=4)
+    b = SelectedRows(np.array([2]), np.ones((1, 2), np.float32) * 3, height=4)
+    env = {"a": a, "b": b, "p": np.zeros((4, 2), np.float32),
+           "lr": np.array([0.5], np.float32)}
+    _run_ops([("sum", {"X": ["a", "b"]}, {"Out": ["s"]}, {}),
+              ("sgd", {"Param": ["p"], "Grad": ["s"],
+                       "LearningRate": ["lr"]},
+               {"ParamOut": ["p2"]}, {})], env)
+    s = env["s"]
+    assert isinstance(s, SelectedRows)
+    p2 = np.asarray(env["p2"])
+    np.testing.assert_allclose(p2[0], [-0.5, -0.5])
+    np.testing.assert_allclose(p2[2], [-2.0, -2.0])
+    np.testing.assert_allclose(p2[1], [0.0, 0.0])
+    # SparseTable param path
+    t = SparseTable(value_dim=2, height=4, seed=0)
+    base = t.gather([0, 2]).copy()
+    env2 = {"t": t, "g": s, "lr": np.array([1.0], np.float32)}
+    _run_ops([("sgd", {"Param": ["t"], "Grad": ["g"],
+                       "LearningRate": ["lr"]},
+               {"ParamOut": ["t"]}, {})], env2)
+    np.testing.assert_allclose(t.gather([0, 2]),
+                               base - np.array([[1, 1], [4, 4]], np.float32))
+
+
+def test_sparse_grad_through_traced_step():
+    """is_sparse embedding: the SelectedRows grad + scatter sgd runs INSIDE
+    one jit trace (the TPU-native sparse update), converging like dense."""
+    import jax
+
+    with program_guard(Program(), Program()):
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[50, 8], is_sparse=True,
+                                     param_attr=fluid.ParamAttr(name="emb_w"))
+        loss = fluid.layers.mean(emb)
+        fluid.optimizer.SGD(learning_rate=1.0).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        w0 = np.array(fluid.executor.fetch_var("emb_w"))
+        idv = np.array([[3], [7], [3]], np.int64)
+        exe.run(feed={"ids": idv}, fetch_list=[loss])
+        w1 = np.array(fluid.executor.fetch_var("emb_w"))
+    touched = sorted({3, 7})
+    untouched = [i for i in range(50) if i not in touched]
+    assert not np.allclose(w1[touched], w0[touched])
+    np.testing.assert_allclose(w1[untouched], w0[untouched])
+    # grad of mean: 1/(3*8) per element; id 3 hit twice
+    np.testing.assert_allclose(w0[3] - w1[3], np.full(8, 2 / 24), rtol=1e-5)
+    np.testing.assert_allclose(w0[7] - w1[7], np.full(8, 1 / 24), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Transpiler rewrite (program text) + end-to-end 2-pserver training
+# ---------------------------------------------------------------------------
+def _build_table_model(vocab=40, dim=8):
+    ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+    emb = fluid.layers.embedding(
+        ids, size=[vocab, dim], is_sparse=True, is_distributed=True,
+        param_attr=fluid.ParamAttr(name="table_w"))
+    fc = fluid.layers.fc(input=emb, size=1,
+                         param_attr=fluid.ParamAttr(name="fc_w"))
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=fc, label=label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_dist_table_transpiler_program_text():
+    pservers = "127.0.0.1:7170,127.0.0.1:7171"
+    with program_guard(Program(), Program()):
+        _build_table_model()
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, pservers=pservers, trainers=1)
+        trainer = t.get_trainer_program()
+        ttypes = [op.type for op in trainer.global_block().ops]
+        assert "lookup_table" not in ttypes
+        assert "prefetch" in ttypes and "merge_ids" in ttypes
+        assert ttypes.count("split_ids") == 2  # ids shard + grad shard
+        # grad-shard send happens before the sync barrier
+        assert ttypes.index("send_vars") < ttypes.index("send_barrier")
+
+        pp = t.get_pserver_program("127.0.0.1:7170")
+        ls = [op for op in pp.global_block().ops
+              if op.type == "listen_and_serv"][0]
+        assert ls.attrs["table_name"] == "table_w"
+        assert ls.attrs["PrefetchBlock"] is not None
+        sub_types = [op.type for b in ls.attrs["OptimizeBlocks"]
+                     for op in b.ops]
+        assert "sgd" in sub_types
+        pf_types = [op.type for op in ls.attrs["PrefetchBlock"].ops]
+        assert pf_types == ["lookup_sparse_table"]
+
+        sp = t.get_startup_program("127.0.0.1:7170", pp)
+        stypes = [op.type for op in sp.global_block().ops]
+        assert "init_sparse_table" in stypes
+        # the table has no dense init on the pserver
+        for op in sp.global_block().ops:
+            if op.type != "init_sparse_table":
+                assert "table_w" not in op.output_arg_names()
+
+
+def test_dist_table_multi_lookup_anchors_after_accumulation():
+    """Two lookups of one distributed table: the grad send must anchor on
+    the LAST writer of <table>@GRAD (the accumulating sum), not the first
+    partial contribution; with 2 trainers the table optimize block must
+    scale the summed grad by 1/trainers like the dense path."""
+    with program_guard(Program(), Program()):
+        a = fluid.layers.data(name="a", shape=[1], dtype="int64")
+        b = fluid.layers.data(name="b", shape=[1], dtype="int64")
+        ea = fluid.layers.embedding(
+            a, size=[30, 4], is_sparse=True, is_distributed=True,
+            param_attr=fluid.ParamAttr(name="table_w"))
+        eb = fluid.layers.embedding(
+            b, size=[30, 4], is_sparse=True, is_distributed=True,
+            param_attr=fluid.ParamAttr(name="table_w"))
+        loss = fluid.layers.mean(ea + eb)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, pservers="127.0.0.1:7470", trainers=2)
+        block = t.get_trainer_program().global_block()
+        grad_writers = [i for i, op in enumerate(block.ops)
+                        if "table_w@GRAD" in op.output_arg_names()]
+        grad_split = next(i for i, op in enumerate(block.ops)
+                          if op.type == "split_ids"
+                          and "table_w@GRAD" in op.input_arg_names())
+        assert grad_split > max(grad_writers), (
+            [op.type for op in block.ops])
+
+        pp = t.get_pserver_program("127.0.0.1:7470")
+        ls = [op for op in pp.global_block().ops
+              if op.type == "listen_and_serv"][0]
+        table_blk = ls.attrs["OptimizeBlocks"][-1]
+        types = [op.type for op in table_blk.ops]
+        assert types == ["sum", "scale", "sgd"], types
+
+
+def test_dist_table_requires_sparse():
+    with program_guard(Program(), Program()):
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[30, 4], is_sparse=False, is_distributed=True,
+            param_attr=fluid.ParamAttr(name="table_w"))
+        loss = fluid.layers.mean(emb)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        t = fluid.DistributeTranspiler()
+        with pytest.raises(AssertionError, match="is_sparse"):
+            t.transpile(trainer_id=0, pservers="127.0.0.1:7471", trainers=1)
+
+
+def _serve_pserver(endpoint, pservers, started, scope_holder):
+    # names must match the trainer's program (they ride the wire), so each
+    # build resets the unique-name generator; builds are serialized by the
+    # caller (start -> wait started -> next)
+    fluid.unique_name.switch()
+    pscope = fluid.Scope()
+    scope_holder[endpoint] = pscope
+    with fluid.scope_guard(pscope):
+        with program_guard(Program(), Program()):
+            _build_table_model()
+            t = fluid.DistributeTranspiler()
+            t.transpile(trainer_id=0, pservers=pservers, trainers=1)
+            pp = t.get_pserver_program(endpoint)
+            sp = t.get_startup_program(endpoint, pp)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(sp)
+            started.set()
+            exe.run(pp)
+
+
+@pytest.mark.slow
+def test_dist_table_train_two_pservers():
+    """2 mod-sharded pservers; the trainer's embedding lookups ride prefetch
+    RPCs and the table updates via SelectedRows sgd — loss must fall and
+    only touched table rows may exist on the pservers."""
+    eps = ["127.0.0.1:7270", "127.0.0.1:7271"]
+    pservers = ",".join(eps)
+    started = [threading.Event(), threading.Event()]
+    scopes = {}
+    threads = [
+        threading.Thread(target=_serve_pserver,
+                         args=(ep, pservers, started[i], scopes), daemon=True)
+        for i, ep in enumerate(eps)
+    ]
+    for th, ev in zip(threads, started):
+        th.start()
+        assert ev.wait(90)
+    time.sleep(0.5)
+    fluid.unique_name.switch()
+
+    rng = np.random.RandomState(0)
+    target = rng.uniform(-1, 1, size=(40,)).astype(np.float32)
+    losses = []
+    try:
+        with program_guard(Program(), Program()):
+            loss = _build_table_model()
+            t = fluid.DistributeTranspiler()
+            t.transpile(trainer_id=0, pservers=pservers, trainers=1)
+            trainer = t.get_trainer_program()
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            seen = set()
+            for step in range(120):
+                ids = rng.randint(0, 40, size=(16, 1)).astype(np.int64)
+                seen.update(ids.reshape(-1).tolist())
+                lbl = target[ids.reshape(-1)].reshape(-1, 1)
+                out, = exe.run(trainer, feed={"ids": ids, "label": lbl},
+                               fetch_list=[loss])
+                losses.append(float(np.asarray(out).reshape(())))
+        assert all(np.isfinite(losses))
+        assert np.mean(losses[-10:]) < 0.3 * np.mean(losses[:10]), (
+            losses[:10], losses[-10:])
+        # each pserver's table only grew rows for its own mod-shard
+        for i, ep in enumerate(eps):
+            table = scopes[ep].find_var("table_w")
+            assert isinstance(table, SparseTable) and len(table) > 0
+            assert all(int(r) % 2 == i for r in table.rows())
+    finally:
+        for ep in eps:
+            try:
+                rpc.VariableClient(ep).shutdown()
+            except Exception:
+                pass
+        from paddle_tpu.ops import rpc_ops
+        rpc_ops.reset_clients()
+        for th in threads:
+            th.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Collective path: mesh-sharded embedding
+# ---------------------------------------------------------------------------
+def test_sharded_embedding_matches_dense():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel import make_mesh, shard_table, \
+        sharded_embedding_lookup
+
+    mesh = make_mesh({"mp": 8})
+    rngk = np.random.RandomState(3)
+    table = rngk.randn(64, 16).astype(np.float32)
+    ids = rngk.randint(0, 64, size=(4, 7)).astype(np.int32)
+    sharded = shard_table(jnp.asarray(table), mesh, axis="mp")
+    got = np.asarray(sharded_embedding_lookup(sharded, jnp.asarray(ids),
+                                              mesh, axis="mp"))
+    np.testing.assert_allclose(got, table[ids], rtol=1e-6)
+
+
+def test_sharded_embedding_grad_is_sharded_scatter():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel import make_mesh, shard_table, \
+        sharded_embedding_lookup
+
+    mesh = make_mesh({"mp": 8})
+    table = np.ones((32, 4), np.float32)
+    ids = np.array([1, 9, 1], np.int32)
+    sharded = shard_table(jnp.asarray(table), mesh, axis="mp")
+
+    def loss_fn(tbl):
+        return sharded_embedding_lookup(tbl, jnp.asarray(ids), mesh,
+                                        axis="mp").sum()
+
+    g = np.asarray(jax.grad(loss_fn)(sharded))
+    ref = np.zeros_like(table)
+    np.add.at(ref, ids, 1.0)
+    np.testing.assert_allclose(g, ref)
