@@ -89,8 +89,9 @@ class SparseTable:
         self.init_low = float(init_low)
         self.init_high = float(init_high)
         self.seed = int(seed)
-        self._index = {}           # id -> row in _data
+        self._index = {}           # id -> row in _data[:_size]
         self._data = np.zeros((0, self.value_dim), self.dtype)
+        self._size = 0             # rows in use (capacity grows geometrically)
 
     def __len__(self):
         return len(self._index)
@@ -110,11 +111,19 @@ class SparseTable:
         new = [i for i in ids if i not in self._index]
         if not new:
             return
-        block = np.stack([self._init_row(i) for i in new])
-        base = self._data.shape[0]
-        self._data = np.concatenate([self._data, block], axis=0)
-        for k, i in enumerate(new):
-            self._index[int(i)] = base + k
+        need = self._size + len(new)
+        if need > self._data.shape[0]:
+            # geometric growth: amortized O(rows) total copy instead of the
+            # O(rows^2) a concatenate-per-miss would cost on the prefetch
+            # hot path (held under the server lock)
+            cap = max(need, 2 * self._data.shape[0], 64)
+            grown = np.zeros((cap, self.value_dim), self.dtype)
+            grown[:self._size] = self._data[:self._size]
+            self._data = grown
+        for i in new:
+            self._data[self._size] = self._init_row(i)
+            self._index[int(i)] = self._size
+            self._size += 1
 
     def gather(self, ids, auto_grow=True):
         """rows for `ids` [N] -> [N, value_dim]; unknown ids are initialized

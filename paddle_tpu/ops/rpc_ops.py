@@ -106,6 +106,31 @@ def send_op(ctx, ins, attrs):
     return result
 
 
+# ---------------------------------------------------------------------------
+# Pserver checkpointing (reference go/pserver/service.go:146 Checkpoint /
+# :175 LoadCheckpoint: CRC-guarded dump of params + optimizer state so a
+# preempted/restarted pserver resumes where it died).
+# ---------------------------------------------------------------------------
+def save_pserver_checkpoint(path, scope, names):
+    from ..core.selected_rows import SparseTable
+
+    state = {}
+    for n in names:
+        v = scope.find_var(n)
+        if v is None:
+            continue
+        state[n] = v if isinstance(v, SparseTable) else np.asarray(v)
+    rpc_runtime.dump_crc_blob(path, state)
+
+
+def load_pserver_checkpoint(path, scope):
+    state = rpc_runtime.load_crc_blob(path)
+    for n, v in state.items():
+        scope.var(n)
+        scope.set_var(n, v)
+    return sorted(state)
+
+
 @register_op("listen_and_serv", no_trace=True, lod_aware=True)
 def listen_and_serv_op(ctx, ins, attrs):
     """Blocking pserver service (reference listen_and_serv_op.cc): receive
@@ -124,6 +149,19 @@ def listen_and_serv_op(ctx, ins, attrs):
 
     exe = Executor(CPUPlace())
 
+    # preemption-aware restart: restore params/optimizer state (and the
+    # sparse table) from the last checkpoint before serving
+    ckpt_path = attrs.get("checkpoint_path")
+    ckpt_every = int(attrs.get("checkpoint_every", 1))
+    import os as _os
+    if ckpt_path and _os.path.exists(ckpt_path):
+        load_pserver_checkpoint(ckpt_path, scope)
+    _persistables = sorted({
+        n for blk in ctx.current_op.block.program.blocks
+        for n, v in blk.vars.items() if v.persistable
+    }) if ckpt_path else []
+    _round = [0]
+
     def get_var(name):
         v = scope.find_var(name)
         if v is None:
@@ -139,6 +177,10 @@ def listen_and_serv_op(ctx, ins, attrs):
         # ParallelExecuteBlocks; sequential here — XLA owns math threads)
         for block in opt_blocks:
             exe.run_block_eager(block, scope)
+        if ckpt_path:
+            _round[0] += 1
+            if _round[0] % ckpt_every == 0:
+                save_pserver_checkpoint(ckpt_path, scope, _persistables)
 
     # async mode: per-grad optimize block (reference async_update.md;
     # grad_to_block_id maps each grad var to its optimize block)
@@ -153,6 +195,10 @@ def listen_and_serv_op(ctx, ins, attrs):
         block = grad_to_block.get(name)
         if block is not None:
             exe.run_block_eager(block, scope)
+        if ckpt_path:
+            _round[0] += 1
+            if _round[0] % ckpt_every == 0:
+                save_pserver_checkpoint(ckpt_path, scope, _persistables)
 
     # distributed lookup table: serve prefetch requests by running the
     # transpiler-built prefetch block (lookup_sparse_table over the local

@@ -44,7 +44,14 @@ def lookup_table_grad_op(ctx, ins, attrs):
     padding_idx = attrs.get("padding_idx", None)
     if padding_idx is not None and padding_idx >= 0:
         gd = jnp.where((idx == padding_idx)[..., None], 0.0, gd)
-    height = w.height if isinstance(w, SparseTable) else w.shape[0]
+    if w is None:
+        # distributed table: the trainer never materializes W — the
+        # transpiler pruned it and recorded the vocab size as an attr
+        assert attrs.get("is_sparse", False), \
+            "lookup_table_grad without W requires is_sparse"
+        height = int(attrs["height"])
+    else:
+        height = w.height if isinstance(w, SparseTable) else w.shape[0]
     if attrs.get("is_sparse", False):
         rows = idx.reshape(-1)
         values = gd.reshape((rows.shape[0],) + gd.shape[idx.ndim:])

@@ -12,6 +12,7 @@ from . import mesh
 from . import distributed
 from . import rpc
 from . import ring
+from . import master
 from . import sharded_embedding
 from .mesh import make_mesh, data_parallel_mesh, mesh_scope
 from .ring import ring_attention, ring_attention_sharded

@@ -1,0 +1,391 @@
+"""Elastic-training coordination service: task-lease master + discovery.
+
+Reference parity: go/master/service.go (task queues :89 Todo/Pending/Done/
+Failed, lease timeout + failure cap :341 processFailedTask, GetTask :373,
+TaskFinished :411 with pass rollover, snapshot :207) and the etcd
+registration in go/pserver/etcd_client.go:67 (here: a TTL'd in-master
+registry, since the zero-dependency equivalent of etcd for this runtime is
+the master itself).
+
+The master hands out *tasks* (groups of data chunks) under a lease: a
+trainer that dies mid-task simply never reports, the lease times out, and
+the task is re-dispatched to a live trainer — up to `failure_max` times,
+after which the task is discarded to `failed`. When todo and pending drain,
+the pass counter advances and done+failed recycle as the next pass's todo.
+Every mutation snapshots state to disk so a restarted master resumes the
+pass where it died (reference snapshots to etcd; here a file, CRC-guarded).
+
+Transport: the same length-prefixed pickle framing as the variable runtime
+(parallel/rpc.py) — this is control-plane traffic, orders of magnitude off
+the data path.
+"""
+
+import socket
+import threading
+import time
+
+from . import rpc as _rpc
+
+__all__ = ["Task", "MasterService", "MasterClient", "task_iterator",
+           "PassAfter", "PassBefore", "NoMoreAvailable", "AllTasksFailed"]
+
+
+class PassBefore(RuntimeError):
+    """Client is on an earlier pass than the master (drop to next pass)."""
+
+
+class PassAfter(RuntimeError):
+    """Client ran ahead of the master; wait for the pass to roll over."""
+
+
+class NoMoreAvailable(RuntimeError):
+    """No todo tasks right now (others still pending); retry shortly."""
+
+
+class AllTasksFailed(RuntimeError):
+    """Every task of the pass hit the failure cap."""
+
+
+_ERRS = {"pass_before": PassBefore, "pass_after": PassAfter,
+         "no_more": NoMoreAvailable, "all_failed": AllTasksFailed}
+
+
+class Task:
+    """reference service.go:62 TaskMeta+Task: id, epoch (lease generation),
+    payload chunks (opaque to the master)."""
+
+    def __init__(self, task_id, chunks):
+        self.id = task_id
+        self.epoch = 0
+        self.num_failure = 0
+        self.chunks = list(chunks)
+
+    def __repr__(self):
+        return f"Task(id={self.id}, epoch={self.epoch}, chunks={len(self.chunks)})"
+
+
+def _partition(chunks, chunks_per_task):
+    """reference partition():105 — group chunks into tasks of
+    chunks_per_task, ids dense from 0 (the reference's nanosecond+rand id
+    dance is a workaround it itself FIXMEs; dense ids snapshot cleanly)."""
+    chunks_per_task = max(1, int(chunks_per_task))
+    tasks = []
+    for i in range(0, len(chunks), chunks_per_task):
+        tasks.append(Task(len(tasks), chunks[i:i + chunks_per_task]))
+    return tasks
+
+
+class MasterService:
+    """In-process task-lease service; serve() exposes it over TCP."""
+
+    def __init__(self, chunks_per_task=1, lease_timeout=3.0, failure_max=3,
+                 snapshot_path=None):
+        self.chunks_per_task = chunks_per_task
+        self.lease_timeout = float(lease_timeout)
+        self.failure_max = int(failure_max)
+        self.snapshot_path = snapshot_path
+        self._mu = threading.Condition()
+        self.todo = []
+        self.pending = {}   # task_id -> (task, deadline)
+        self.done = []
+        self.failed = []
+        self.cur_pass = 0
+        self._registry = {}  # (kind, name) -> (addr, expire_time)
+        self._stop = False
+        self._init_done = False
+        self._checker = threading.Thread(target=self._timeout_loop,
+                                         daemon=True)
+        self._checker.start()
+
+    # ---------------------------------------------------------------- state
+    def set_dataset(self, chunks):
+        """reference SetDataset:281 — idempotent after first success."""
+        with self._mu:
+            if self._init_done:
+                return
+            self.todo = _partition(chunks, self.chunks_per_task)
+            self._init_done = True
+            self._snapshot_locked()
+
+    def _snapshot_locked(self):
+        """reference snapshot():207 — persist queues + pass counter."""
+        if not self.snapshot_path:
+            return
+        state = {"todo": self.todo, "pending": self.pending,
+                 "done": self.done, "failed": self.failed,
+                 "cur_pass": self.cur_pass, "init_done": self._init_done}
+        _rpc.dump_crc_blob(self.snapshot_path, state)
+
+    @classmethod
+    def recover(cls, snapshot_path, **kwargs):
+        """Restart from a snapshot: pending leases are conservatively
+        requeued (their holders may have died with the master; reference
+        recover() reloads state and lets timeouts sort it out — with the
+        AfterFunc timers lost, requeueing is the correct translation)."""
+        state = _rpc.load_crc_blob(snapshot_path)
+        svc = cls(snapshot_path=snapshot_path, **kwargs)
+        with svc._mu:
+            svc.todo = state["todo"] + [t for t, _ in
+                                        state["pending"].values()]
+            svc.done = state["done"]
+            svc.failed = state["failed"]
+            svc.cur_pass = state["cur_pass"]
+            svc._init_done = state["init_done"]
+        return svc
+
+    # ---------------------------------------------------------------- tasks
+    def get_task(self, pass_id):
+        """reference GetTask:373."""
+        with self._mu:
+            if not self._init_done:
+                raise NoMoreAvailable("dataset not set yet")
+            if pass_id < self.cur_pass:
+                raise PassBefore(f"client pass {pass_id} < {self.cur_pass}")
+            if pass_id > self.cur_pass:
+                raise PassAfter(f"client pass {pass_id} > {self.cur_pass}")
+            if not self.todo:
+                if not self.done and not self.pending:
+                    raise AllTasksFailed("all tasks of this pass failed")
+                raise NoMoreAvailable("no todo tasks (others pending)")
+            t = self.todo.pop(0)
+            t.epoch += 1
+            self.pending[t.id] = (t, time.monotonic() + self.lease_timeout)
+            self._snapshot_locked()
+            return t
+
+    def task_finished(self, task_id):
+        """reference TaskFinished:411 (incl. pass rollover)."""
+        with self._mu:
+            entry = self.pending.pop(task_id, None)
+            if entry is None:
+                return  # late report after timeout requeue: ignore
+            t, _ = entry
+            t.num_failure = 0
+            self.done.append(t)
+            self._maybe_rollover_locked()
+            self._snapshot_locked()
+
+    def _maybe_rollover_locked(self):
+        """Advance the pass when todo+pending drain. Must ALSO run on the
+        failure paths: if the pass's last outstanding task hits the failure
+        cap, waiting for a task_finished that can never come would livelock
+        every trainer in NoMoreAvailable. (The reference only checks in
+        TaskFinished — its own 'deal with failed tasks' TODO.) A pass with
+        zero successes stays put so get_task raises AllTasksFailed."""
+        if not self.todo and not self.pending and self.done:
+            self.cur_pass += 1
+            self.todo = self.done + self.failed
+            for t2 in self.todo:
+                t2.num_failure = 0
+            self.done, self.failed = [], []
+            self._mu.notify_all()
+
+    def task_failed(self, task_id, epoch):
+        """reference TaskFailed:454."""
+        with self._mu:
+            entry = self.pending.get(task_id)
+            if entry is None:
+                return
+            self._process_failed_locked(task_id, epoch)
+            self._maybe_rollover_locked()
+            self._snapshot_locked()
+
+    def _process_failed_locked(self, task_id, epoch):
+        """reference processFailedTask:341."""
+        t, _ = self.pending[task_id]
+        if t.epoch != epoch:
+            return  # stale report from a previous lease
+        del self.pending[task_id]
+        t.num_failure += 1
+        if t.num_failure > self.failure_max:
+            self.failed.append(t)
+        else:
+            self.todo.append(t)
+
+    def _timeout_loop(self):
+        """Lease reaper (reference time.AfterFunc per dispatch; a scan
+        thread is equivalent and survives recover())."""
+        while not self._stop:
+            time.sleep(min(0.1, self.lease_timeout / 4))
+            now = time.monotonic()
+            with self._mu:
+                expired = [(tid, t.epoch)
+                           for tid, (t, dl) in self.pending.items()
+                           if dl <= now]
+                for tid, epoch in expired:
+                    self._process_failed_locked(tid, epoch)
+                if expired:
+                    self._maybe_rollover_locked()
+                    self._snapshot_locked()
+                # registry TTL expiry
+                dead = [k for k, (_, exp) in self._registry.items()
+                        if exp <= now]
+                for k in dead:
+                    del self._registry[k]
+
+    def counts(self):
+        with self._mu:
+            return {"todo": len(self.todo), "pending": len(self.pending),
+                    "done": len(self.done), "failed": len(self.failed),
+                    "cur_pass": self.cur_pass}
+
+    # ------------------------------------------------------------ discovery
+    def register(self, kind, name, addr, ttl=10.0):
+        """reference etcd_client.go:67 Register — TTL'd; heartbeat by
+        re-registering."""
+        with self._mu:
+            self._registry[(kind, name)] = (addr, time.monotonic() + ttl)
+
+    def lookup(self, kind):
+        with self._mu:
+            now = time.monotonic()
+            return {name: addr for (k, name), (addr, exp)
+                    in self._registry.items() if k == kind and exp > now}
+
+    # -------------------------------------------------------------- serving
+    def serve(self, bind="127.0.0.1:0"):
+        host, port = bind.rsplit(":", 1)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(32)
+        self.port = self._listener.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        return self.port
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._listener.close()
+        except (AttributeError, OSError):
+            pass
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                self._listener.settimeout(0.2)
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                msg = _rpc._recv_msg(conn)
+                op, args = msg[0], msg[1:]
+                try:
+                    if op == "set_dataset":
+                        self.set_dataset(args[0])
+                        reply = ("ok", None)
+                    elif op == "get_task":
+                        t = self.get_task(args[0])
+                        reply = ("ok", (t.id, t.epoch, t.chunks))
+                    elif op == "task_finished":
+                        self.task_finished(args[0])
+                        reply = ("ok", None)
+                    elif op == "task_failed":
+                        self.task_failed(args[0], args[1])
+                        reply = ("ok", None)
+                    elif op == "register":
+                        self.register(*args)
+                        reply = ("ok", None)
+                    elif op == "lookup":
+                        reply = ("ok", self.lookup(args[0]))
+                    elif op == "counts":
+                        reply = ("ok", self.counts())
+                    elif op == "exit":
+                        self.stop()
+                        return
+                    else:
+                        reply = ("err", f"unknown op {op!r}")
+                except tuple(_ERRS.values()) as e:
+                    key = next(k for k, cls in _ERRS.items()
+                               if isinstance(e, cls))
+                    reply = ("taskerr", key, str(e))
+                _rpc._send_msg(conn, reply)
+        except (ConnectionError, EOFError, OSError):
+            return
+
+
+class MasterClient:
+    """reference go/master/client.go + python v2 master client."""
+
+    def __init__(self, endpoint, connect_timeout=30.0):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+
+    def _call(self, *msg):
+        with self._lock:
+            _rpc._send_msg(self._sock, msg)
+            resp = _rpc._recv_msg(self._sock)
+        if resp[0] == "taskerr":
+            raise _ERRS[resp[1]](resp[2])
+        if resp[0] != "ok":
+            raise _rpc.RpcError(str(resp[1:]))
+        return resp[1]
+
+    def set_dataset(self, chunks):
+        return self._call("set_dataset", list(chunks))
+
+    def get_task(self, pass_id):
+        tid, epoch, chunks = self._call("get_task", pass_id)
+        t = Task(tid, chunks)
+        t.epoch = epoch
+        return t
+
+    def task_finished(self, task_id):
+        return self._call("task_finished", task_id)
+
+    def task_failed(self, task_id, epoch):
+        return self._call("task_failed", task_id, epoch)
+
+    def register(self, kind, name, addr, ttl=10.0):
+        return self._call("register", kind, name, addr, ttl)
+
+    def lookup(self, kind):
+        return self._call("lookup", kind)
+
+    def counts(self):
+        return self._call("counts")
+
+    def shutdown(self):
+        try:
+            with self._lock:
+                _rpc._send_msg(self._sock, ("exit",))
+        except OSError:
+            pass
+        self._sock.close()
+
+
+def task_iterator(client, pass_id, poll_interval=0.1, max_wait=60.0):
+    """Generator a trainer drives one pass with: lease tasks, yield their
+    chunks, report finished; ends when the master rolls to the next pass
+    (the python v2 master reader-creator equivalent). On an exception inside
+    the consumer the task is reported failed, not finished."""
+    deadline = time.monotonic() + max_wait
+    while True:
+        try:
+            task = client.get_task(pass_id)
+        except (PassBefore, AllTasksFailed):
+            return
+        except (NoMoreAvailable, PassAfter):
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(poll_interval)
+            continue
+        deadline = time.monotonic() + max_wait
+        try:
+            for chunk in task.chunks:
+                yield chunk
+        except BaseException:
+            client.task_failed(task.id, task.epoch)
+            raise
+        client.task_finished(task.id)

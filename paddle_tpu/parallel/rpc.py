@@ -40,6 +40,32 @@ def _check_ok(resp, what):
 _MAGIC = b"PTRV"
 
 
+def dump_crc_blob(path, obj):
+    """Atomically persist `obj` as CRC32-prefixed pickle (tmp + rename) —
+    the snapshot framing shared by the master service and the pserver
+    checkpointer (reference guards both with CRC32 too,
+    go/pserver/service.go:190)."""
+    import zlib
+
+    payload = pickle.dumps(obj, protocol=4)
+    blob = zlib.crc32(payload).to_bytes(4, "big") + payload
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+
+
+def load_crc_blob(path):
+    import zlib
+
+    with open(path, "rb") as f:
+        blob = f.read()
+    crc, payload = blob[:4], blob[4:]
+    if zlib.crc32(payload).to_bytes(4, "big") != crc:
+        raise IOError(f"corrupt snapshot/checkpoint {path!r}")
+    return pickle.loads(payload)
+
+
 def _send_msg(sock, obj):
     payload = pickle.dumps(obj, protocol=4)
     sock.sendall(_MAGIC + struct.pack(">Q", len(payload)) + payload)

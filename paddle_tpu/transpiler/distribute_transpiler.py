@@ -240,6 +240,25 @@ class DistributeTranspiler:
                 program, pserver_endpoints)
             self._split_table_grad_and_add_send_vars(
                 program, pserver_endpoints)
+            self._prune_table_from_trainer(program)
+
+    def _prune_table_from_trainer(self, program):
+        """A distributed table exists because it exceeds one worker's
+        memory — after the prefetch rewrite nothing on the trainer reads a
+        row of it, so drop its dense init and detach it from the grad op
+        (which only needed W for the vocab size)."""
+        block = program.global_block()
+        table_var = block.vars[self.table_name]
+        for op in block.ops:
+            if op.type == "lookup_table_grad" and \
+                    op.input("W") == [self.table_name]:
+                op.inputs["W"] = []
+                op.attrs["height"] = int(table_var.shape[0])
+        sb = self.startup_program.global_block()
+        sb.ops = [op for op in sb.ops
+                  if self.table_name not in op.output_arg_names()]
+        self.startup_program._mutation += 1
+        program._mutation += 1
 
     # ------------------------------------------------------------------
     # distributed lookup table (reference :624-822)

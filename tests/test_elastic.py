@@ -1,0 +1,297 @@
+"""Elastic training: task-lease master, discovery, snapshot/recover,
+pserver checkpoint/restore.
+
+Reference: go/master/service_internal_test.go + the service semantics at
+go/master/service.go:89 (queues), :341 (processFailedTask), :373 (GetTask),
+:411 (TaskFinished pass rollover), :207 (snapshot); pserver checkpoint at
+go/pserver/service.go:146,175.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel.master import (
+    MasterService, MasterClient, Task, task_iterator,
+    NoMoreAvailable, PassAfter, AllTasksFailed)
+
+
+def _svc(**kw):
+    kw.setdefault("lease_timeout", 0.3)
+    kw.setdefault("failure_max", 2)
+    return MasterService(**kw)
+
+
+def test_partition_and_basic_flow():
+    svc = _svc(chunks_per_task=2)
+    svc.set_dataset(list(range(7)))  # 4 tasks: [0,1],[2,3],[4,5],[6]
+    assert svc.counts()["todo"] == 4
+    got = []
+    while True:
+        try:
+            t = svc.get_task(0)
+        except NoMoreAvailable:
+            break
+        got.extend(t.chunks)
+        svc.task_finished(t.id)
+        if svc.counts()["cur_pass"] == 1:
+            break
+    assert sorted(got) == list(range(7))
+    c = svc.counts()
+    # pass rolled over: done recycled into todo for pass 1
+    assert c["cur_pass"] == 1 and c["todo"] == 4 and c["done"] == 0
+    svc.stop()
+
+
+def test_lease_timeout_requeues_and_failure_cap_discards():
+    svc = _svc(chunks_per_task=1, lease_timeout=0.2, failure_max=1)
+    svc.set_dataset(["a"])
+    t = svc.get_task(0)
+    assert svc.counts()["pending"] == 1
+    time.sleep(0.5)  # lease expires -> requeued (failure 1)
+    assert svc.counts() == {"todo": 1, "pending": 0, "done": 0,
+                            "failed": 0, "cur_pass": 0}
+    t = svc.get_task(0)
+    svc.task_failed(t.id, t.epoch)  # failure 2 > failure_max -> discarded
+    assert svc.counts()["failed"] == 1
+    with pytest.raises(AllTasksFailed):
+        svc.get_task(0)
+    svc.stop()
+
+
+def test_stale_failure_report_ignored():
+    """A timeout-requeued task re-leased to another worker must not be
+    killed by the original worker's late failure report (epoch check,
+    reference processFailedTask:344)."""
+    svc = _svc(chunks_per_task=1, lease_timeout=0.2, failure_max=5)
+    svc.set_dataset(["a"])
+    t1 = svc.get_task(0)
+    e1 = t1.epoch  # capture: in-process callers share the Task object
+    time.sleep(0.5)  # worker 1 considered dead; task requeued
+    t2 = svc.get_task(0)
+    assert t2.id == t1.id and t2.epoch == e1 + 1
+    svc.task_failed(t1.id, e1)  # late report with stale epoch
+    assert svc.counts()["pending"] == 1  # lease still held by worker 2
+    svc.task_finished(t2.id)
+    assert svc.counts()["cur_pass"] == 1
+    svc.stop()
+
+
+def test_pass_rolls_over_when_last_task_fails_at_cap():
+    """If the pass's final outstanding task hits the failure cap while
+    other tasks already finished, the pass must still roll over —
+    otherwise every trainer livelocks in NoMoreAvailable."""
+    svc = _svc(chunks_per_task=1, lease_timeout=60.0, failure_max=0)
+    svc.set_dataset(["good", "bad"])
+    ta = svc.get_task(0)
+    tb = svc.get_task(0)
+    svc.task_finished(ta.id)
+    svc.task_failed(tb.id, tb.epoch)  # cap 0 -> discarded
+    c = svc.counts()
+    assert c["cur_pass"] == 1, c
+    # the failed task recycles into the next pass alongside the done one
+    assert c["todo"] == 2 and c["failed"] == 0, c
+    t = svc.get_task(1)  # next pass serves immediately, no livelock
+    assert t.chunks[0] in ("good", "bad")
+    svc.stop()
+
+
+def test_snapshot_recover_resumes_pass():
+    path = "/tmp/master_snapshot_test.bin"
+    if os.path.exists(path):
+        os.remove(path)
+    svc = _svc(chunks_per_task=1, snapshot_path=path)
+    svc.set_dataset(["a", "b", "c"])
+    t = svc.get_task(0)
+    svc.task_finished(t.id)
+    t2 = svc.get_task(0)  # leased but never finished: master dies now
+    svc.stop()
+
+    svc2 = MasterService.recover(path, chunks_per_task=1,
+                                 lease_timeout=0.3, failure_max=2)
+    c = svc2.counts()
+    # 1 done, the in-flight lease conservatively requeued with the last todo
+    assert c["done"] == 1 and c["todo"] == 2 and c["pending"] == 0
+    remaining = []
+    for _ in range(2):
+        t = svc2.get_task(0)
+        remaining.append(t.chunks[0])
+        svc2.task_finished(t.id)
+    assert set(remaining) | {"a"} >= {"a", "b", "c"}
+    assert svc2.counts()["cur_pass"] == 1
+    svc2.stop()
+
+
+def test_master_over_tcp_and_discovery():
+    svc = _svc(chunks_per_task=2)
+    port = svc.serve()
+    c = MasterClient(f"127.0.0.1:{port}")
+    try:
+        c.set_dataset([1, 2, 3, 4])
+        c.register("pserver", "ps0", "127.0.0.1:6000", ttl=5.0)
+        c.register("pserver", "ps1", "127.0.0.1:6001", ttl=0.1)
+        t = c.get_task(0)
+        assert isinstance(t, Task) and len(t.chunks) == 2
+        c.task_finished(t.id)
+        time.sleep(0.5)  # ps1's TTL expires
+        assert c.lookup("pserver") == {"ps0": "127.0.0.1:6000"}
+        assert c.counts()["done"] == 1
+    finally:
+        c.shutdown()
+        svc.stop()
+
+
+def test_killed_trainer_mid_epoch_pass_completes():
+    """The VERDICT scenario: trainer A dies mid-epoch holding a lease; the
+    lease times out, the task re-dispatches, and trainer B finishes the
+    pass with correct accounting (every chunk consumed by a finisher)."""
+    svc = _svc(chunks_per_task=1, lease_timeout=0.3, failure_max=3)
+    port = svc.serve()
+    chunks = [f"chunk{i}" for i in range(6)]
+
+    def trainer_a():
+        c = MasterClient(f"127.0.0.1:{port}")
+        c.set_dataset(chunks)
+        t = c.get_task(0)
+        # dies mid-task: never reports, never closes the lease
+        return t
+
+    consumed = []
+
+    def trainer_b():
+        c = MasterClient(f"127.0.0.1:{port}")
+        c.set_dataset(chunks)  # idempotent second init
+        for chunk in task_iterator(c, pass_id=0, max_wait=10.0):
+            consumed.append(chunk)
+            time.sleep(0.01)
+        c.shutdown()
+
+    ta = threading.Thread(target=trainer_a, daemon=True)
+    ta.start()
+    ta.join(10)
+    tb = threading.Thread(target=trainer_b, daemon=True)
+    tb.start()
+    tb.join(30)
+    assert not tb.is_alive()
+    c = svc.counts()
+    assert c["cur_pass"] == 1, c  # pass completed despite the dead trainer
+    assert c["failed"] == 0 and c["pending"] == 0, c
+    # every chunk was processed by the surviving trainer (A's chunk was
+    # re-dispatched after its lease expired)
+    assert sorted(consumed) == sorted(chunks), consumed
+    svc.stop()
+
+
+def test_pserver_checkpoint_roundtrip():
+    from paddle_tpu.ops.rpc_ops import (save_pserver_checkpoint,
+                                        load_pserver_checkpoint)
+    from paddle_tpu.core.selected_rows import SparseTable
+
+    path = "/tmp/pserver_ckpt_test.bin"
+    if os.path.exists(path):
+        os.remove(path)
+    scope = fluid.Scope()
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    scope.var("W"); scope.set_var("W", w)
+    t = SparseTable(value_dim=4, height=20, seed=1)
+    t.gather([3, 7])
+    scope.var("table"); scope.set_var("table", t)
+    save_pserver_checkpoint(path, scope, ["W", "table", "missing"])
+
+    scope2 = fluid.Scope()
+    names = load_pserver_checkpoint(path, scope2)
+    assert names == ["W", "table"]
+    np.testing.assert_array_equal(scope2.find_var("W"), w)
+    t2 = scope2.find_var("table")
+    assert isinstance(t2, SparseTable) and len(t2) == 2
+    np.testing.assert_allclose(t2.gather([3, 7]), t.gather([3, 7]))
+    # corruption is detected, not silently loaded
+    with open(path, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff")
+    with pytest.raises(IOError, match="corrupt"):
+        load_pserver_checkpoint(path, fluid.Scope())
+
+
+@pytest.mark.slow
+def test_pserver_restart_restores_state():
+    """Kill a pserver after a checkpointed round; a restarted pserver with
+    the same checkpoint_path serves the updated params (reference pserver
+    recovery from checkpoint on restart)."""
+    from paddle_tpu.core.framework import Program, program_guard
+
+    path = "/tmp/pserver_restart_ckpt.bin"
+    if os.path.exists(path):
+        os.remove(path)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=2, bias_attr=False,
+                            param_attr=fluid.ParamAttr(name="W"))
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    def serve(endpoint, scope, started, sync=True):
+        fluid.unique_name.switch()
+        with fluid.scope_guard(scope):
+            with program_guard(Program(), Program()):
+                build()
+                t = fluid.DistributeTranspiler()
+                t.transpile(trainer_id=0, pservers=endpoint, trainers=1,
+                            sync_mode=sync)
+                pp = t.get_pserver_program(endpoint)
+                ls = [op for op in pp.global_block().ops
+                      if op.type == "listen_and_serv"][0]
+                ls.attrs["checkpoint_path"] = path
+                sp = t.get_startup_program(endpoint, pp)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(sp)
+                started.set()
+                exe.run(pp)
+
+    from paddle_tpu.parallel.rpc import VariableClient
+    from paddle_tpu.ops import rpc_ops
+
+    ep1 = "127.0.0.1:7570"
+    s1 = fluid.Scope()
+    started = threading.Event()
+    th = threading.Thread(target=serve, args=(ep1, s1, started), daemon=True)
+    th.start()
+    assert started.wait(60)
+    time.sleep(0.3)
+
+    c = VariableClient(ep1)
+    g = np.full((4, 2), 1.0, np.float32)
+    c.send_var("W@GRAD", g)
+    c.batch_barrier()
+    w_after = np.asarray(c.get_var("W"))
+    c.fetch_barrier()
+    c.shutdown()
+    th.join(10)
+    assert os.path.exists(path), "round did not checkpoint"
+
+    # restart on a fresh port + fresh scope: startup re-inits W, then the
+    # checkpoint restore overwrites it with the trained value
+    # async mode so the get is served without waiting for a sync round
+    ep2 = "127.0.0.1:7571"
+    s2 = fluid.Scope()
+    started2 = threading.Event()
+    th2 = threading.Thread(target=serve, args=(ep2, s2, started2, False),
+                           daemon=True)
+    th2.start()
+    assert started2.wait(60)
+    time.sleep(0.5)
+    c2 = VariableClient(ep2)
+    try:
+        w_restored = np.asarray(c2.get_var("W"))
+        np.testing.assert_allclose(w_restored, w_after)
+    finally:
+        c2.shutdown()
+        rpc_ops.reset_clients()
+        th2.join(10)
+    if os.path.exists(path):
+        os.remove(path)

@@ -251,6 +251,15 @@ def test_dist_table_transpiler_program_text():
             if op.type != "init_sparse_table":
                 assert "table_w" not in op.output_arg_names()
 
+        # the trainer never materializes the dense [vocab, dim] table: its
+        # startup init is pruned and the grad op carries height as an attr
+        for op in fluid.default_startup_program().global_block().ops:
+            assert "table_w" not in op.output_arg_names()
+        gops = [op for op in trainer.global_block().ops
+                if op.type == "lookup_table_grad"]
+        assert gops and all(op.input("W") == [] for op in gops)
+        assert all(op.attrs["height"] == 40 for op in gops)
+
 
 def test_dist_table_multi_lookup_anchors_after_accumulation():
     """Two lookups of one distributed table: the grad send must anchor on
