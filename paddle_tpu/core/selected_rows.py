@@ -108,7 +108,9 @@ class SparseTable:
                            self.value_dim).astype(self.dtype)
 
     def _grow(self, ids):
-        new = [i for i in ids if i not in self._index]
+        # dedupe while preserving order: a repeated unseen id must claim
+        # exactly one row (duplicates would orphan rows forever)
+        new = list(dict.fromkeys(i for i in ids if i not in self._index))
         if not new:
             return
         need = self._size + len(new)

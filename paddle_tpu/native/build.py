@@ -34,3 +34,7 @@ def build_library(name, sources, extra_flags=()):
 
 def recordio_lib():
     return build_library("recordio", ["recordio.cc"], ["-lz"])
+
+
+def infer_lib():
+    return build_library("ptinfer", ["infer.cc"])

@@ -155,7 +155,14 @@ def listen_and_serv_op(ctx, ins, attrs):
     ckpt_every = int(attrs.get("checkpoint_every", 1))
     import os as _os
     if ckpt_path and _os.path.exists(ckpt_path):
-        load_pserver_checkpoint(ckpt_path, scope)
+        try:
+            load_pserver_checkpoint(ckpt_path, scope)
+        except Exception as e:
+            # a torn/corrupt checkpoint must not brick the pserver — fall
+            # back to the startup-initialized params and checkpoint afresh
+            import sys
+            print(f"[paddle_tpu] WARNING: ignoring unreadable pserver "
+                  f"checkpoint {ckpt_path!r}: {e}", file=sys.stderr)
     _persistables = sorted({
         n for blk in ctx.current_op.block.program.blocks
         for n, v in blk.vars.items() if v.persistable

@@ -79,11 +79,16 @@ class MasterService:
     """In-process task-lease service; serve() exposes it over TCP."""
 
     def __init__(self, chunks_per_task=1, lease_timeout=3.0, failure_max=3,
-                 snapshot_path=None):
+                 snapshot_path=None, snapshot_every=32):
         self.chunks_per_task = chunks_per_task
         self.lease_timeout = float(lease_timeout)
         self.failure_max = int(failure_max)
         self.snapshot_path = snapshot_path
+        # batch snapshots: a full-state pickle per dispatch is O(dataset)
+        # under the lock; recover() requeues pending leases anyway, so a
+        # slightly stale snapshot only replays a few reports
+        self.snapshot_every = max(1, int(snapshot_every))
+        self._mutations = 0
         self._mu = threading.Condition()
         self.todo = []
         self.pending = {}   # task_id -> (task, deadline)
@@ -105,11 +110,16 @@ class MasterService:
                 return
             self.todo = _partition(chunks, self.chunks_per_task)
             self._init_done = True
-            self._snapshot_locked()
+            self._snapshot_locked(force=True)
 
-    def _snapshot_locked(self):
-        """reference snapshot():207 — persist queues + pass counter."""
+    def _snapshot_locked(self, force=False):
+        """reference snapshot():207 — persist queues + pass counter.
+        Unforced calls batch by mutation count; pass boundaries, dataset
+        init, and stop() force a write."""
         if not self.snapshot_path:
+            return
+        self._mutations += 1
+        if not force and self._mutations % self.snapshot_every != 0:
             return
         state = {"todo": self.todo, "pending": self.pending,
                  "done": self.done, "failed": self.failed,
@@ -179,6 +189,7 @@ class MasterService:
                 t2.num_failure = 0
             self.done, self.failed = [], []
             self._mu.notify_all()
+            self._snapshot_locked(force=True)
 
     def task_failed(self, task_id, epoch):
         """reference TaskFailed:454."""
@@ -256,6 +267,8 @@ class MasterService:
 
     def stop(self):
         self._stop = True
+        with self._mu:
+            self._snapshot_locked(force=True)
         try:
             self._listener.close()
         except (AttributeError, OSError):
@@ -356,7 +369,17 @@ class MasterClient:
     def counts(self):
         return self._call("counts")
 
-    def shutdown(self):
+    def close(self):
+        """Disconnect THIS client; the master keeps serving other trainers
+        (a departing trainer must never take the coordination service — and
+        every live lease reaper — down with it)."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def shutdown_service(self):
+        """Stop the master service itself (job teardown)."""
         try:
             with self._lock:
                 _rpc._send_msg(self._sock, ("exit",))

@@ -52,6 +52,8 @@ def dump_crc_blob(path, obj):
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())  # rename-before-data after power loss = torn file
     os.replace(tmp, path)
 
 

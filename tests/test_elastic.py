@@ -104,7 +104,7 @@ def test_snapshot_recover_resumes_pass():
     path = "/tmp/master_snapshot_test.bin"
     if os.path.exists(path):
         os.remove(path)
-    svc = _svc(chunks_per_task=1, snapshot_path=path)
+    svc = _svc(chunks_per_task=1, snapshot_path=path, snapshot_every=1)
     svc.set_dataset(["a", "b", "c"])
     t = svc.get_task(0)
     svc.task_finished(t.id)
@@ -140,8 +140,13 @@ def test_master_over_tcp_and_discovery():
         time.sleep(0.5)  # ps1's TTL expires
         assert c.lookup("pserver") == {"ps0": "127.0.0.1:6000"}
         assert c.counts()["done"] == 1
+        # a departing client must NOT take the service down with it
+        c.close()
+        c2 = MasterClient(f"127.0.0.1:{port}")
+        assert c2.counts()["done"] == 1
+        c2.close()
+        assert not svc._stop
     finally:
-        c.shutdown()
         svc.stop()
 
 
@@ -168,7 +173,7 @@ def test_killed_trainer_mid_epoch_pass_completes():
         for chunk in task_iterator(c, pass_id=0, max_wait=10.0):
             consumed.append(chunk)
             time.sleep(0.01)
-        c.shutdown()
+        c.close()
 
     ta = threading.Thread(target=trainer_a, daemon=True)
     ta.start()

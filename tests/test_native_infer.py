@@ -1,0 +1,140 @@
+"""Native inference predictor: train in Python -> serve from C++ with no
+Python/JAX in the loop, outputs matching the XLA executor.
+
+Reference: paddle/contrib/inference/test_paddle_inference_api_impl.cc
+(train + save + native Run + compare) and inference/io.cc load tests.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program, program_guard
+from paddle_tpu.native.infer import NativePredictor
+
+
+def _train_and_save(tmpdir, build_fn, feed_maker, steps=3):
+    with program_guard(Program(), Program()):
+        feeds, targets, loss = build_fn()
+        opt = fluid.optimizer.SGD(learning_rate=0.01)
+        opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        for _ in range(steps):
+            exe.run(feed=feed_maker(), fetch_list=[loss])
+        fluid.io.save_inference_model(
+            str(tmpdir), [v.name for v in feeds], targets, exe)
+        # reference outputs through the XLA path on the saved model
+        infer_scope = fluid.Scope()
+        with fluid.scope_guard(infer_scope):
+            prog, feed_names, fetch_targets = fluid.io.load_inference_model(
+                str(tmpdir), exe)
+            fd = feed_maker()
+            want = exe.run(prog,
+                           feed={n: fd[n] for n in feed_names},
+                           fetch_list=fetch_targets)
+        return fd, [np.asarray(w) for w in want]
+
+
+def test_mlp_round_trip(tmp_path):
+    rng = np.random.RandomState(7)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        h = fluid.layers.fc(input=h, size=24, act="tanh")
+        probs = fluid.layers.fc(input=h, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=probs, label=label))
+        return [x], [probs], loss
+
+    def feed():
+        return {"x": rng.randn(8, 16).astype(np.float32),
+                "label": rng.randint(0, 10, (8, 1)).astype(np.int64)}
+
+    fd, want = _train_and_save(tmp_path, build, feed)
+    pred = NativePredictor(str(tmp_path))
+    assert pred.feed_names == ["x"]
+    got = pred.run({"x": fd["x"]})
+    assert len(got) == 1 and got[0].shape == want[0].shape
+    np.testing.assert_allclose(got[0], want[0], rtol=2e-5, atol=1e-6)
+    # probabilities: rows sum to 1
+    np.testing.assert_allclose(got[0].sum(axis=1), np.ones(8), rtol=1e-5)
+    pred.close()
+
+
+def test_cnn_round_trip(tmp_path):
+    rng = np.random.RandomState(3)
+
+    def build():
+        img = fluid.layers.data(name="img", shape=[1, 12, 12],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        conv = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                   padding=1, act="relu")
+        bn = fluid.layers.batch_norm(input=conv)
+        pool = fluid.layers.pool2d(input=bn, pool_size=2, pool_stride=2,
+                                   pool_type="max")
+        probs = fluid.layers.fc(input=pool, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=probs, label=label))
+        return [img], [probs], loss
+
+    def feed():
+        return {"img": rng.randn(4, 1, 12, 12).astype(np.float32),
+                "label": rng.randint(0, 10, (4, 1)).astype(np.int64)}
+
+    fd, want = _train_and_save(tmp_path, build, feed)
+    pred = NativePredictor(str(tmp_path))
+    got = pred.run({"img": fd["img"]})
+    np.testing.assert_allclose(got[0], want[0], rtol=2e-4, atol=1e-5)
+    pred.close()
+
+
+def test_embedding_round_trip(tmp_path):
+    rng = np.random.RandomState(11)
+
+    def build():
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(ids, size=[50, 8])
+        y = fluid.layers.fc(input=emb, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=y, label=label))
+        return [ids], [y], loss
+
+    def feed():
+        return {"ids": rng.randint(0, 50, (6, 1)).astype(np.int64),
+                "label": rng.randn(6, 1).astype(np.float32)}
+
+    fd, want = _train_and_save(tmp_path, build, feed)
+    pred = NativePredictor(str(tmp_path))
+    got = pred.run({"ids": fd["ids"]})
+    np.testing.assert_allclose(got[0], want[0], rtol=2e-5, atol=1e-6)
+    pred.close()
+
+
+def test_errors_are_surfaced(tmp_path):
+    with pytest.raises(RuntimeError, match="load failed"):
+        NativePredictor(str(tmp_path / "nonexistent"))
+
+    rng = np.random.RandomState(0)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+        y = fluid.layers.fc(input=x, size=2)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=y, label=label))
+        return [x], [y], loss
+
+    def feed():
+        return {"x": rng.randn(3, 4).astype(np.float32),
+                "label": rng.randn(3, 1).astype(np.float32)}
+
+    _train_and_save(tmp_path, build, feed)
+    pred = NativePredictor(str(tmp_path))
+    with pytest.raises(ValueError, match="missing feeds"):
+        pred.run({})
+    pred.close()
