@@ -83,6 +83,7 @@ from .data_feeder import DataFeeder
 from .trainer import Trainer, BeginEpochEvent, EndEpochEvent, BeginStepEvent, EndStepEvent
 from .inferencer import Inferencer
 from . import amp
+from . import flags
 from . import transpiler
 from .transpiler import DistributeTranspiler, InferenceTranspiler, memory_optimize, release_memory
 from .unique_name import generate as _generate_unique_name
@@ -109,5 +110,5 @@ __all__ = [
     "ParamAttr", "WeightNormParamAttr", "DataFeeder",
     "Trainer", "Inferencer", "transpiler", "DistributeTranspiler",
     "InferenceTranspiler", "memory_optimize", "release_memory",
-    "reader", "dataset", "batch", "unique_name", "parallel",
+    "reader", "dataset", "batch", "unique_name", "parallel", "flags",
 ]

@@ -20,6 +20,34 @@ import numpy as np
 from . import registry
 from .registry import SeqTensor
 from . import dtypes
+from .. import flags
+
+
+def check_values_finite(named_values, context=""):
+    """FLAGS_check_nan_inf (reference executor.cc:343 CheckTensorNANOrInf):
+    raise naming the first variable containing NaN/Inf."""
+    from .selected_rows import SelectedRows
+
+    for name, v in named_values:
+        if isinstance(v, SeqTensor):
+            v = v.data
+        elif isinstance(v, SelectedRows):
+            v = v.values
+        if not hasattr(v, "dtype") or not hasattr(v, "shape"):
+            continue
+        try:
+            kind = np.dtype(v.dtype).kind
+        except TypeError:
+            kind = "f" if str(v.dtype) == "bfloat16" else "O"
+        if kind != "f" and str(v.dtype) != "bfloat16":
+            continue
+        arr = np.asarray(v, dtype=np.float32) \
+            if str(v.dtype) == "bfloat16" else np.asarray(v)
+        if not np.isfinite(arr).all():
+            what = "NaN" if np.isnan(arr).any() else "Inf"
+            raise RuntimeError(
+                f"Variable {name!r} contains {what}{context} "
+                f"(FLAGS_check_nan_inf)")
 
 
 class TraceUnsupported(Exception):
@@ -50,6 +78,12 @@ class OpContext:
         return env
 
 
+def _profiler_enabled():
+    from .. import profiler
+
+    return profiler._enabled
+
+
 def env_get(env, name, allow_missing=False):
     if name in env:
         return env[name]
@@ -76,11 +110,24 @@ def run_ops(ops, env, ctx):
                 for n in names
             ]
         try:
-            outs = registry.run_kernel(op_def, ctx, ins, op.attrs) or {}
+            if ctx.eager and _profiler_enabled():
+                from .. import profiler
+                with profiler.record_event(f"op::{op.type}"):
+                    outs = registry.run_kernel(op_def, ctx, ins, op.attrs) or {}
+            else:
+                outs = registry.run_kernel(op_def, ctx, ins, op.attrs) or {}
         except TraceUnsupported:
             raise
         except Exception as e:
             raise type(e)(f"while running op {op.type!r} ({op!r}): {e}") from e
+        if ctx.eager and flags.get("check_nan_inf"):
+            named = []
+            for slot, names in op.outputs.items():
+                vals = outs.get(slot, [])
+                for i, n in enumerate(names):
+                    if n and i < len(vals) and vals[i] is not None:
+                        named.append((n, vals[i]))
+            check_values_finite(named, context=f" after op {op.type!r}")
         for slot, names in op.outputs.items():
             vals = outs.get(slot, [])
             for i, name in enumerate(names):

@@ -281,9 +281,35 @@ def make_vjp_kernel(fwd_def):
 # Kernel-call wrapper used by the executor: handles SeqTensor auto-unwrap for
 # non-lod-aware kernels + LoD propagation (reference ShareLoD semantics).
 # ---------------------------------------------------------------------------
+# Op-coverage tracking (tools/op_coverage.py): when PADDLE_TPU_TRACK_OPS
+# names a file, every kernel invocation records its op type; the set is
+# written at interpreter exit. Zero overhead when the env var is unset.
+import os as _os
+
+_TRACK_FILE = _os.environ.get("PADDLE_TPU_TRACK_OPS")
+_tracked_ops = set()
+if _TRACK_FILE:
+    import atexit as _atexit
+
+    def _dump_tracked():
+        # O_APPEND + a single write: concurrent test subprocesses exiting
+        # together must not clobber each other (a read-merge-rewrite races);
+        # duplicates are merged at read time by tools/op_coverage.py
+        try:
+            if _tracked_ops:
+                with open(_TRACK_FILE, "a") as f:
+                    f.write("\n".join(sorted(_tracked_ops)) + "\n")
+        except OSError:
+            pass
+
+    _atexit.register(_dump_tracked)
+
+
 def run_kernel(op_def, ctx, ins, attrs):
     from .. import amp
 
+    if _TRACK_FILE:
+        _tracked_ops.add(op_def.type)
     ins = amp.apply_policy(op_def.type, ins)
     if op_def.lod_aware:
         return op_def.fn(ctx, ins, attrs)

@@ -7,10 +7,13 @@ host-side ops (save/load/print/readers/listen_and_serv) run in the eager
 interpret mode, matching the reference's op-by-op Executor semantics.
 """
 
+import time
+
 import numpy as np
 import jax
 
 from . import amp
+from . import flags
 from .core import executor_core, registry
 from .core.framework import Program, Variable, default_main_program
 from .core.lod_tensor import LoDTensor
@@ -21,6 +24,15 @@ from .core.registry import SeqTensor
 __all__ = ["Executor", "global_scope", "scope_guard", "fetch_var"]
 
 from .core.scope import scope_guard  # re-export (reference executor.py:39)
+
+
+def jnp_ravel_first(leaf):
+    """First scalar of a trace leaf (SeqTensor-aware) for fence readbacks."""
+    if isinstance(leaf, SeqTensor):
+        leaf = leaf.data
+    import jax.numpy as jnp
+
+    return jnp.ravel(jnp.asarray(leaf))[:1]
 
 
 def as_numpy(tensor):
@@ -142,9 +154,28 @@ class Executor:
                 v = executor_core.feed_to_tracevalue(v)
             (mut_state if n in out_set else const_state)[n] = v
         rng = self._rng_for(program)
+        t0 = time.perf_counter() if flags.get("benchmark") else None
         fetches, new_mut = compiled(mut_state, const_state, feed_vals, rng)
+        # write back BEFORE any nan check can raise: mut_state was donated,
+        # so skipping this would leave the scope holding deleted buffers
         for n, v in new_mut.items():
             scope.set_var(n, v)
+        if t0 is not None:  # FLAGS_benchmark: synchronize + report
+            # fence with a scalar readback: on the tunneled TPU platform
+            # block_until_ready does not reliably block (see bench.py), and
+            # in-order execution means one scalar fences the whole step
+            leaves = jax.tree_util.tree_leaves((fetches, new_mut))
+            if leaves:
+                np.asarray(jax.device_get(jnp_ravel_first(leaves[0])))
+            import sys
+            print(f"[paddle_tpu] run: {(time.perf_counter() - t0) * 1000:.3f}"
+                  f" ms (fetches={len(fetches)})", file=sys.stderr)
+        if flags.get("check_nan_inf"):
+            # per-op blame isn't available inside one XLA computation; check
+            # the step boundary (fetches + updated state) and name the var
+            executor_core.check_values_finite(
+                list(zip(fetch_names, fetches)) + list(new_mut.items()),
+                context=" after compiled step")
         return [self._to_host(f) for f in fetches]
 
     def _to_host(self, value):

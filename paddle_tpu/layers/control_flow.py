@@ -15,8 +15,29 @@ __all__ = [
     "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
     "array_to_lod_tensor", "increment", "array_write", "create_array",
     "less_than", "equal", "array_read", "shrink_memory", "array_length",
-    "zeros_like", "reorder_lod_tensor_by_rank",
+    "zeros_like", "reorder_lod_tensor_by_rank", "Print",
 ]
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """reference layers/control_flow.py Print:165 — debug-print a tensor as
+    a pass-through op. On TPU it lowers to jax.debug.print inside the
+    compiled step (the reference had to run it host-side). `summarize`
+    truncates to the first N elements; `first_n` / `print_phase` /
+    `print_tensor_*` are accepted for signature parity but are no-ops — the
+    op runs inside one traced computation, which has no per-invocation
+    counter and no separate backward program to phase against."""
+    helper = LayerHelper("print", **locals())
+    out = helper.create_tmp_variable(dtype=input.dtype, shape=input.shape,
+                                     lod_level=input.lod_level)
+    helper.append_op(
+        "print", {"In": [input]}, {"Out": [out]},
+        {"message": message or input.name, "summarize": summarize},
+    )
+    return out
 
 
 def less_than(x, y, cond=None, **ignored):

@@ -8,7 +8,7 @@ from .. import unique_name
 __all__ = [
     "data", "BlockGuardServ", "ListenAndServ", "Send", "Recv",
     "open_recordio_file", "open_files", "read_file", "shuffle", "batch",
-    "double_buffer", "random_data_generator",
+    "double_buffer", "multi_pass", "random_data_generator",
 ]
 
 
@@ -220,6 +220,13 @@ def double_buffer(reader, place=None, name=None):
     prefetch. On TPU the executor overlaps via async dispatch; this keeps the
     program-level decorator for parity."""
     return _decorate_reader("double_buffer_reader", reader, {})
+
+
+def multi_pass(reader, pass_num):
+    """reference create_multi_pass_reader_op.cc — replay the underlying
+    reader pass_num times (epoch loop as a reader decorator)."""
+    return _decorate_reader("multi_pass_reader", reader,
+                            {"pass_num": pass_num})
 
 
 def random_data_generator(low, high, shapes, lod_levels, for_parallel=False):

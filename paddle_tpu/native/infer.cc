@@ -226,6 +226,11 @@ struct Tensor {
   }
 };
 
+// Copy-free alias when already F32 (the common case: weights are loaded as
+// F32 once and must not be memcpy'd per request); converts into `scratch`
+// otherwise.
+const Tensor& as_f32(const Tensor& t, Tensor& scratch);
+
 Tensor to_f32(const Tensor& t) {
   if (t.dtype == F32) return t;
   Tensor o;
@@ -241,6 +246,12 @@ Tensor to_f32(const Tensor& t) {
     }
   }
   return o;
+}
+
+const Tensor& as_f32(const Tensor& t, Tensor& scratch) {
+  if (t.dtype == F32) return t;
+  scratch = to_f32(t);
+  return scratch;
 }
 
 // ----------------------------------------------------------- NPY loader ---
@@ -372,7 +383,9 @@ Tensor broadcast_like(const Tensor& x, const Tensor& y, int axis) {
   if (y.dims == x.dims) return to_f32(y);
   int xr = (int)x.dims.size(), yr = (int)y.dims.size();
   if (axis < 0) axis = xr - yr;
-  Tensor yf = to_f32(y);
+  Tensor yf_s;
+
+  const Tensor& yf = as_f32(y, yf_s);
   Tensor o;
   o.dtype = F32;
   o.dims = x.dims;
@@ -411,8 +424,11 @@ void run_op(const OpDesc& op, Env& env) {
   if (t == "feed" || t == "fetch") return;
 
   if (t == "mul") {
-    Tensor x = to_f32(need(env, op.in("X")));
-    Tensor y = to_f32(need(env, op.in("Y")));
+    Tensor x_s;
+
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
+    Tensor y_s;
+    const Tensor& y = as_f32(need(env, op.in("Y")), y_s);
     int xn = (int)op.attr_num("x_num_col_dims", 1);
     int yn = (int)op.attr_num("y_num_col_dims", 1);
     int64_t m = 1, k = 1, k2 = 1, n = 1;
@@ -433,7 +449,9 @@ void run_op(const OpDesc& op, Env& env) {
 
   if (t == "elementwise_add" || t == "elementwise_sub" ||
       t == "elementwise_mul" || t == "elementwise_div") {
-    Tensor x = to_f32(need(env, op.in("X")));
+    Tensor x_s;
+
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
     Tensor yb = broadcast_like(x, need(env, op.in("Y")),
                                (int)op.attr_num("axis", -1));
     Tensor o;
@@ -453,7 +471,9 @@ void run_op(const OpDesc& op, Env& env) {
 
   if (t == "relu" || t == "sigmoid" || t == "tanh" || t == "sqrt" ||
       t == "exp" || t == "abs") {
-    Tensor x = to_f32(need(env, op.in("X")));
+    Tensor x_s;
+
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
     Tensor o;
     o.dtype = F32;
     o.dims = x.dims;
@@ -472,7 +492,9 @@ void run_op(const OpDesc& op, Env& env) {
   }
 
   if (t == "softmax" || t == "log_softmax") {
-    Tensor x = to_f32(need(env, op.in("X")));
+    Tensor x_s;
+
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
     Tensor o;
     o.dtype = F32;
     o.dims = x.dims;
@@ -494,7 +516,9 @@ void run_op(const OpDesc& op, Env& env) {
   }
 
   if (t == "scale") {
-    Tensor x = to_f32(need(env, op.in("X")));
+    Tensor x_s;
+
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
     float s = (float)op.attr_num("scale", 1.0);
     float b = (float)op.attr_num("bias", 0.0);
     bool after = op.attr_bool("bias_after_scale", true);
@@ -509,7 +533,9 @@ void run_op(const OpDesc& op, Env& env) {
   }
 
   if (t == "dropout") {  // inference: downgrade_in_infer (out = x*(1-p))
-    Tensor x = to_f32(need(env, op.in("X")));
+    Tensor x_s;
+
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
     float keep = 1.f - (float)op.attr_num("dropout_prob", 0.5);
     Tensor o;
     o.dtype = F32;
@@ -521,11 +547,17 @@ void run_op(const OpDesc& op, Env& env) {
   }
 
   if (t == "batch_norm") {  // is_test semantics: running stats
-    Tensor x = to_f32(need(env, op.in("X")));
-    Tensor sc = to_f32(need(env, op.in("Scale")));
-    Tensor bi = to_f32(need(env, op.in("Bias")));
-    Tensor mu = to_f32(need(env, op.in("Mean")));
-    Tensor va = to_f32(need(env, op.in("Variance")));
+    Tensor x_s;
+
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
+    Tensor sc_s;
+    const Tensor& sc = as_f32(need(env, op.in("Scale")), sc_s);
+    Tensor bi_s;
+    const Tensor& bi = as_f32(need(env, op.in("Bias")), bi_s);
+    Tensor mu_s;
+    const Tensor& mu = as_f32(need(env, op.in("Mean")), mu_s);
+    Tensor va_s;
+    const Tensor& va = as_f32(need(env, op.in("Variance")), va_s);
     float eps = (float)op.attr_num("epsilon", 1e-5);
     int64_t C = x.dims.size() > 1 ? x.dims[1] : x.dims[0];
     int64_t inner = 1;
@@ -549,8 +581,11 @@ void run_op(const OpDesc& op, Env& env) {
   }
 
   if (t == "conv2d" || t == "depthwise_conv2d") {  // NCHW, OIHW
-    Tensor x = to_f32(need(env, op.in("Input")));
-    Tensor w = to_f32(need(env, op.in("Filter")));
+    Tensor x_s;
+
+    const Tensor& x = as_f32(need(env, op.in("Input")), x_s);
+    Tensor w_s;
+    const Tensor& w = as_f32(need(env, op.in("Filter")), w_s);
     auto strides = op.attr_ints("strides");
     auto pads = op.attr_ints("paddings");
     auto dil = op.attr_ints("dilations");
@@ -594,7 +629,9 @@ void run_op(const OpDesc& op, Env& env) {
   }
 
   if (t == "pool2d") {
-    Tensor x = to_f32(need(env, op.in("X")));
+    Tensor x_s;
+
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
     std::string ptype = "max";
     if (op.attrs->at("pooling_type")->kind == JValue::STR)
       ptype = op.attrs->at("pooling_type")->s;
@@ -646,7 +683,9 @@ void run_op(const OpDesc& op, Env& env) {
   if (t == "lookup_table") {
     const Tensor& w = need(env, op.in("W"));
     const Tensor& ids = need(env, op.in("Ids"));
-    Tensor wf = to_f32(w);
+    Tensor wf_s;
+
+    const Tensor& wf = as_f32(w, wf_s);
     int64_t D = w.dims[1];
     int64_t n = ids.numel();
     int64_t pad = (int64_t)op.attr_num("padding_idx", -1);
@@ -716,7 +755,9 @@ void run_op(const OpDesc& op, Env& env) {
   }
 
   if (t == "mean") {
-    Tensor x = to_f32(need(env, op.in("X")));
+    Tensor x_s;
+
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
     Tensor o;
     o.dtype = F32;
     o.dims = {};

@@ -226,7 +226,7 @@ def max_sequence_len_op(ctx, ins, attrs):
     return out(Out=jnp.max(lengths).astype(jnp.int64))
 
 
-@register_op("lod_tensor_to_array", lod_aware=True)
+@register_op("lod_tensor_to_array", lod_aware=True, no_trace=True)
 def lod_tensor_to_array_op(ctx, ins, attrs):
     """Bucket a ragged batch into per-timestep arrays (DynamicRNN input).
     Produces a TensorArray of [B_t, D] slices in rank-table order; B_t is the
@@ -250,7 +250,7 @@ def lod_tensor_to_array_op(ctx, ins, attrs):
     return out(Out=arr)
 
 
-@register_op("array_to_lod_tensor", lod_aware=True)
+@register_op("array_to_lod_tensor", lod_aware=True, no_trace=True)
 def array_to_lod_tensor_op(ctx, ins, attrs):
     import numpy as np
 
@@ -280,7 +280,7 @@ def array_to_lod_tensor_op(ctx, ins, attrs):
     return out(Out=SeqTensor(data, jnp.asarray(lens, jnp.int32)))
 
 
-@register_op("shrink_rnn_memory", lod_aware=True)
+@register_op("shrink_rnn_memory", lod_aware=True, no_trace=True)
 def shrink_rnn_memory_op(ctx, ins, attrs):
     """Shrink memory batch to sequences still alive at step I."""
     import numpy as np
@@ -319,11 +319,16 @@ def fetch_op(ctx, ins, attrs):
 @register_op("print", lod_aware=True)
 def print_op(ctx, ins, attrs):
     """reference print_op.cc — uses jax.debug.print so it works inside the
-    compiled step (the reference had to run it on the host)."""
+    compiled step (the reference had to run it on the host). summarize>0
+    truncates to the first N elements like the reference."""
     x = first(ins, "In")
     msg = attrs.get("message", "")
     data = x.data if isinstance(x, SeqTensor) else x
-    jax.debug.print(msg + " {}", data)
+    summarize = int(attrs.get("summarize", -1) or -1)
+    shown = data
+    if summarize > 0:
+        shown = data.reshape(-1)[:summarize]
+    jax.debug.print(msg + " {}", shown)
     return out(Out=x)
 
 

@@ -13,7 +13,7 @@ from collections import defaultdict
 import jax
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
-           "stop_profiler", "record_event"]
+           "stop_profiler", "record_event", "export_chrome_trace"]
 
 _host_events = []  # (name, start, end)
 _enabled = False
@@ -95,6 +95,33 @@ def _print_summary(sorted_key, profile_path):
             f.write(report)
     except OSError:
         pass
+
+
+def export_chrome_trace(path):
+    """Write recorded host events as a chrome://tracing / Perfetto JSON
+    file (reference tools/timeline.py:1 Timeline._build_chrome_trace).
+
+    Host rows cover executor ops and user record_event() spans; the DEVICE
+    timeline is the XLA trace jax.profiler writes to the trace_dir passed
+    to start_profiler (open both in Perfetto for the merged picture — the
+    reference merges CUPTI + host events into one proto the same way)."""
+    import json
+
+    events = []
+    for ev in _host_events:
+        events.append({
+            "name": ev.name,
+            "ph": "X",  # complete event
+            "ts": ev.start * 1e6,
+            "dur": (ev.end - ev.start) * 1e6,
+            "pid": 0,
+            "tid": "host",
+            "cat": "host",
+        })
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return path
 
 
 @contextlib.contextmanager

@@ -1,0 +1,107 @@
+"""Flag registry + NaN/Inf sanitizer + timeline export.
+
+Reference: FLAGS_check_nan_inf (framework/executor.cc:27,343), the
+__bootstrap__ env flag parsing (python/paddle/fluid/__init__.py:70), and
+tools/timeline.py's chrome-trace output.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags, profiler
+
+
+def test_flag_define_get_set_and_env(monkeypatch):
+    with pytest.raises(KeyError):
+        flags.get("no_such_flag")
+    assert flags.get("check_nan_inf") is False
+    flags.set("check_nan_inf", True)
+    assert flags.get("check_nan_inf") is True
+    flags.reset("check_nan_inf")
+    assert flags.get("check_nan_inf") is False
+    # env override wins at define time (gflags convention)
+    monkeypatch.setenv("FLAGS_bench_test_flag", "7")
+    flags.define("bench_test_flag", int, 3, "test")
+    assert flags.get("bench_test_flag") == 7
+    with pytest.raises(ValueError):
+        flags.set("bench_test_flag", "not-an-int")
+    # bool coercion from env-style strings
+    flags.set("check_nan_inf", "true")
+    assert flags.get("check_nan_inf") is True
+    flags.reset()
+    assert flags.get("check_nan_inf") is False
+    info = flags.all_flags()
+    assert "check_nan_inf" in info and info["check_nan_inf"][1] == "bool"
+
+
+def test_flag_guard_restores():
+    with flags.flag_guard(check_nan_inf=True):
+        assert flags.get("check_nan_inf") is True
+    assert flags.get("check_nan_inf") is False
+
+
+def _nan_program():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.log(x)  # log(-1) -> NaN
+    loss = fluid.layers.mean(y)
+    return loss
+
+
+def test_check_nan_inf_compiled_path():
+    loss = _nan_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    bad = -np.ones((2, 4), np.float32)
+    # off: silently returns NaN (reference default)
+    out, = exe.run(feed={"x": bad}, fetch_list=[loss])
+    assert np.isnan(np.asarray(out)).all()
+    with flags.flag_guard(check_nan_inf=True):
+        with pytest.raises(RuntimeError, match="NaN"):
+            exe.run(feed={"x": bad}, fetch_list=[loss])
+        # clean input passes
+        out, = exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[loss])
+        assert np.isfinite(np.asarray(out)).all()
+
+
+def _force_eager(var):
+    """Append a host-only op so the program takes the eager interpreter."""
+    scrap = fluid.layers.scale(var, scale=1.0)
+    fluid.default_main_program().global_block().append_op(
+        "delete_var", {"X": [scrap]}, {}, {})
+
+
+def test_check_nan_inf_eager_path_names_op():
+    """Eager programs (host ops present) get per-op blame."""
+    loss = _nan_program()
+    _force_eager(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with flags.flag_guard(check_nan_inf=True):
+        with pytest.raises(RuntimeError, match="after op"):
+            exe.run(feed={"x": -np.ones((2, 4), np.float32)},
+                    fetch_list=[loss])
+
+
+def test_timeline_export(tmp_path):
+    profiler.reset_profiler()
+    profiler.start_profiler("CPU")  # host events only (no jax trace dir)
+    with profiler.record_event("stage::load"):
+        pass
+    # eager executor run records per-op events
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    y = fluid.layers.scale(x, scale=2.0)
+    _force_eager(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(feed={"x": np.ones((1, 2), np.float32)}, fetch_list=[y])
+    path = str(tmp_path / "timeline.json")
+    profiler.export_chrome_trace(path)
+    profiler.stop_profiler()
+    with open(path) as f:
+        trace = json.load(f)
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "stage::load" in names
+    assert any(n.startswith("op::scale") for n in names)
+    assert all(e["ph"] == "X" and "dur" in e for e in trace["traceEvents"])

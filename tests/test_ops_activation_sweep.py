@@ -1,0 +1,196 @@
+"""Activation-family sweep: every registered activation gets an output
+check against its numpy reference and (where smooth at the sampled points)
+a finite-difference grad check.
+
+Reference: unittests/test_activation_op.py (~30 TestCase classes with
+check_output + check_grad each).
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _make(op_type, x, ref, attrs=None):
+    class T(OpTest):
+        def setup(self):
+            self.op_type = op_type
+            self.inputs = {"X": x}
+            self.outputs = {"Out": ref(x).astype(np.float32)}
+            self.attrs = attrs or {}
+
+    return T()
+
+
+# (op, numpy reference, attrs, input domain, grad_ok)
+# inputs are sampled away from kinks so finite differences are valid
+_POS = ("pos", 0.5, 3.0)          # strictly positive
+_ANY = ("any", -2.0, 2.0)
+_OFF0 = ("off0", 0.3, 2.0)        # |x| in [0.3, 2]: away from 0
+CASES = [
+    ("sigmoid", _sigmoid, {}, _ANY, True),
+    ("logsigmoid", lambda x: np.log(_sigmoid(x)), {}, _ANY, True),
+    ("exp", np.exp, {}, _ANY, True),
+    ("relu", lambda x: np.maximum(x, 0), {}, _OFF0, True),
+    ("tanh", np.tanh, {}, _ANY, True),
+    ("tanh_shrink", lambda x: x - np.tanh(x), {}, _ANY, True),
+    ("softshrink",
+     lambda x: np.sign(x) * np.maximum(np.abs(x) - 0.4, 0.0),
+     {"lambda": 0.4}, ("shrink", 0.6, 2.0), True),
+    ("hard_shrink",
+     lambda x: np.where(np.abs(x) > 0.5, x, 0.0), {"threshold": 0.5},
+     ("shrink", 0.7, 2.0), True),
+    ("sqrt", np.sqrt, {}, _POS, True),
+    ("abs", np.abs, {}, _OFF0, True),
+    ("ceil", np.ceil, {}, ("frac", 0.1, 0.9), False),
+    ("floor", np.floor, {}, ("frac", 0.1, 0.9), False),
+    ("round", np.round, {}, ("frac", 0.1, 0.4), False),
+    ("cos", np.cos, {}, _ANY, True),
+    ("sin", np.sin, {}, _ANY, True),
+    ("reciprocal", lambda x: 1.0 / x, {}, _POS, True),
+    ("log", np.log, {}, _POS, True),
+    ("square", np.square, {}, _ANY, True),
+    ("softplus", lambda x: np.log1p(np.exp(x)), {}, _ANY, True),
+    ("softsign", lambda x: x / (1 + np.abs(x)), {}, _OFF0, True),
+    ("brelu", lambda x: np.clip(x, 0.5, 1.5),
+     {"t_min": 0.5, "t_max": 1.5}, ("interior", 0.7, 1.3), True),
+    ("leaky_relu", lambda x: np.where(x >= 0, x, 0.1 * x),
+     {"alpha": 0.1}, _OFF0, True),
+    ("soft_relu", lambda x: np.log1p(np.exp(np.clip(x, -40.0, 40.0))),
+     {"threshold": 40.0}, _ANY, True),
+    ("elu", lambda x: np.where(x >= 0, x, 1.0 * (np.exp(x) - 1)),
+     {"alpha": 1.0}, _OFF0, True),
+    ("relu6", lambda x: np.clip(x, 0, 6.0), {"threshold": 6.0},
+     ("interior", 0.5, 5.5), True),
+    ("pow", lambda x: np.power(x, 2.0), {"factor": 2.0}, _POS, True),
+    ("stanh", lambda x: 1.7159 * np.tanh((2.0 / 3.0) * x), {}, _ANY, True),
+    ("hard_sigmoid", lambda x: np.clip(0.2 * x + 0.5, 0, 1), {},
+     ("interior", -1.5, 1.5), True),
+    ("thresholded_relu", lambda x: np.where(x > 1.0, x, 0.0),
+     {"threshold": 1.0}, ("above", 1.3, 2.5), True),
+    ("swish", lambda x: x * _sigmoid(x), {"beta": 1.0}, _ANY, True),
+    ("gelu",
+     lambda x: 0.5 * x * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                      * (x + 0.044715 * x ** 3))),
+     {}, _ANY, True),
+]
+
+
+def _sample(domain, rng, shape=(3, 4)):
+    kind, lo, hi = domain
+    x = rng.uniform(lo, hi, shape).astype(np.float32)
+    if kind in ("off0", "shrink"):
+        sign = np.where(rng.rand(*shape) < 0.5, -1.0, 1.0).astype(np.float32)
+        x = x * sign
+    return x
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
+def test_activation_output(case):
+    op, ref, attrs, domain, _ = case
+    rng = np.random.RandomState(hash(op) % 2 ** 31)
+    t = _make(op, _sample(domain, rng), ref, attrs)
+    t.check_output(atol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "case", [c for c in CASES if c[4]], ids=[c[0] for c in CASES if c[4]])
+def test_activation_grad(case):
+    op, ref, attrs, domain, _ = case
+    rng = np.random.RandomState(hash(op) % 2 ** 31)
+    t = _make(op, _sample(domain, rng), ref, attrs)
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+# ---------------------------------------------------------------------------
+# elementwise stragglers (min / pow / sub), logical + compare ops
+# ---------------------------------------------------------------------------
+def test_elementwise_min_sub_pow():
+    rng = np.random.RandomState(5)
+    x = rng.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    y = rng.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    for op, ref in [("elementwise_min", np.minimum(x, y)),
+                    ("elementwise_sub", x - y),
+                    ("elementwise_pow", np.power(x, y))]:
+        class T(OpTest):
+            def setup(self):
+                self.op_type = op
+                self.inputs = {"X": x, "Y": y}
+                self.outputs = {"Out": ref.astype(np.float32)}
+
+        T().check_output(atol=2e-5)
+
+    class TGrad(OpTest):
+        def setup(self):
+            self.op_type = "elementwise_sub"
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": (x - y)}
+
+    TGrad().check_grad(["X", "Y"], "Out")
+
+
+def test_logical_and_compare_ops():
+    rng = np.random.RandomState(6)
+    a = rng.rand(3, 4) > 0.5
+    b = rng.rand(3, 4) > 0.5
+    for op, ref in [("logical_and", a & b), ("logical_or", a | b),
+                    ("logical_xor", a ^ b)]:
+        class T(OpTest):
+            def setup(self):
+                self.op_type = op
+                self.inputs = {"X": a, "Y": b}
+                self.outputs = {"Out": ref}
+
+        T().check_output()
+
+    class TNot(OpTest):
+        def setup(self):
+            self.op_type = "logical_not"
+            self.inputs = {"X": a}
+            self.outputs = {"Out": ~a}
+
+    TNot().check_output()
+
+    x = rng.randint(0, 4, (6,)).astype(np.int64)
+    y = rng.randint(0, 4, (6,)).astype(np.int64)
+    for op, ref in [("less_than", x < y), ("less_equal", x <= y),
+                    ("greater_than", x > y), ("greater_equal", x >= y),
+                    ("equal", x == y), ("not_equal", x != y)]:
+        class TC(OpTest):
+            def setup(self):
+                self.op_type = op
+                self.inputs = {"X": x, "Y": y}
+                self.outputs = {"Out": ref}
+
+        TC().check_output()
+
+
+def test_isfinite_and_is_empty():
+    class T(OpTest):
+        def setup(self):
+            self.op_type = "isfinite"
+            self.inputs = {"X": np.array([1.0, 2.0], np.float32)}
+            self.outputs = {"Out": np.array(True)}
+
+    T().check_output()
+
+    class TBad(OpTest):
+        def setup(self):
+            self.op_type = "isfinite"
+            self.inputs = {"X": np.array([1.0, np.nan], np.float32)}
+            self.outputs = {"Out": np.array(False)}
+
+    TBad().check_output()
+
+    class TE(OpTest):
+        def setup(self):
+            self.op_type = "is_empty"
+            self.inputs = {"X": np.ones((2, 2), np.float32)}
+            self.outputs = {"Out": np.array(False)}
+
+    TE().check_output()

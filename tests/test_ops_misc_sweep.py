@@ -1,0 +1,255 @@
+"""Collective ops (8-device mesh), detection ops, and nn stragglers.
+
+Reference: unittests/test_nccl_op.py (collectives), test_roi_pool_op.py,
+test_iou_similarity_op.py, test_box_coder_op.py, test_lrn_op.py,
+test_bilinear_interp_op.py, test_conv2d_transpose_op.py, test_conv3d_op.py,
+test_maxout_op.py, test_prelu_op.py.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from op_test import OpTest
+from paddle_tpu.core import executor_core
+from paddle_tpu.core.registry import lookup
+from paddle_tpu.parallel import make_mesh
+
+
+def run_op(op_type):
+    """Kernel entry via registry.run_kernel (tracked, AMP-aware)."""
+    from paddle_tpu.core import registry
+
+    d = registry.lookup(op_type)
+    return lambda ctx, ins, attrs: registry.run_kernel(d, ctx, ins, attrs)
+
+
+
+class _T(OpTest):
+    def __init__(self, op_type, inputs, outputs, attrs=None, atol=None):
+        self.op_type = op_type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs or {}
+        if atol is not None:
+            self.atol = atol
+
+    def setup(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# collectives: run each kernel inside shard_map over the 8-device CPU mesh
+# ---------------------------------------------------------------------------
+def _run_collective(op_type, x, attrs, out_spec):
+    mesh = make_mesh({"dp": 8})
+    ctx = executor_core.OpContext(eager=True)
+    fn = run_op(op_type)
+
+    def local(shard):
+        return fn(ctx, {"X": [shard]}, attrs)["Out"][0]
+
+    mapped = jax.shard_map(local, mesh=mesh, in_specs=P("dp"),
+                           out_specs=out_spec, check_vma=False)
+    return np.asarray(mapped(jnp.asarray(x)))
+
+
+def test_all_reduce_sum_mean_max():
+    x = np.arange(16, dtype=np.float32).reshape(8, 2)
+    got = _run_collective("all_reduce", x,
+                          {"axis_name": "dp", "reduction": "sum"}, P("dp"))
+    # every shard's row replaced by the sum over shards, then restacked
+    np.testing.assert_allclose(got, np.tile(x.sum(0), (8, 1)))
+    got = _run_collective("all_reduce", x,
+                          {"axis_name": "dp", "reduction": "mean"}, P("dp"))
+    np.testing.assert_allclose(got, np.tile(x.mean(0), (8, 1)))
+    got = _run_collective("all_reduce", x,
+                          {"axis_name": "dp", "reduction": "max"}, P("dp"))
+    np.testing.assert_allclose(got, np.tile(x.max(0), (8, 1)))
+
+
+def test_all_gather():
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    got = _run_collective("all_gather", x, {"axis_name": "dp"},
+                          P("dp", None))
+    # each device's [1,1] shard gathers to [8,1,1]; restacked -> [64,1,1]
+    assert got.shape == (64, 1, 1)
+    np.testing.assert_allclose(got.reshape(8, 8), np.tile(x.T, (8, 1)))
+
+
+def test_reduce_scatter():
+    mesh = make_mesh({"dp": 8})
+    ctx = executor_core.OpContext(eager=True)
+    fn = run_op("reduce_scatter")
+
+    def local(shard):  # [1, 8] -> [8] so the scatter dim divides by 8
+        return fn(ctx, {"X": [shard.reshape(8)]},
+                  {"axis_name": "dp"})["Out"][0]
+
+    mapped = jax.shard_map(local, mesh=mesh, in_specs=P("dp"),
+                           out_specs=P("dp"), check_vma=False)
+    got = np.asarray(mapped(jnp.ones((8, 8), jnp.float32)))
+    # device i holds sum over devices of element i
+    np.testing.assert_allclose(got, np.full((8,), 8.0))
+
+
+def test_broadcast_from_root():
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    got = _run_collective("broadcast", x, {"axis_name": "dp", "root": 3},
+                          P("dp"))
+    np.testing.assert_allclose(got, np.full((8, 1), 3.0))
+
+
+def test_collective_permute_ring():
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    perm = [[i, (i + 1) % 8] for i in range(8)]
+    got = _run_collective("collective_permute", x,
+                          {"axis_name": "dp", "perm": perm}, P("dp"))
+    np.testing.assert_allclose(got.reshape(-1), np.roll(np.arange(8.0), 1))
+
+
+def test_collectives_identity_outside_mesh():
+    ctx = executor_core.OpContext(eager=True)
+    x = jnp.ones((3,))
+    for op in ["all_reduce", "all_gather", "reduce_scatter", "broadcast"]:
+        attrs = {"axis_name": "dp"}
+        got = run_op(op)(ctx, {"X": [x]}, attrs)["Out"][0]
+        np.testing.assert_allclose(np.asarray(got), np.ones((3,)))
+
+
+# ---------------------------------------------------------------------------
+# detection ops
+# ---------------------------------------------------------------------------
+def test_iou_similarity():
+    a = np.asarray([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    b = np.asarray([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+    want = np.asarray([[1.0, 0.0], [1.0 / 7.0, 1.0 / 7.0]], np.float32)
+    _T("iou_similarity", {"X": a, "Y": b}, {"Out": want}).check_output(
+        atol=1e-5)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    prior = np.asarray([[0, 0, 2, 2], [1, 1, 4, 5]], np.float32)
+    var = np.ones((2, 4), np.float32) * 0.5
+    target = np.asarray([[0.5, 0.5, 2.5, 3.0], [0, 1, 3, 4]], np.float32)
+    ctx = executor_core.OpContext(eager=True)
+    enc = run_op("box_coder")(
+        ctx, {"PriorBox": [jnp.asarray(prior)], "PriorBoxVar": [jnp.asarray(var)],
+              "TargetBox": [jnp.asarray(target)]},
+        {"code_type": "encode_center_size"})["OutputBox"][0]
+    # decode back: encoded [N, M, 4] -> take diagonal (target i vs prior i)
+    enc_np = np.asarray(enc)
+    diag = np.stack([enc_np[i, i] for i in range(2)])
+    dec = run_op("box_coder")(
+        ctx, {"PriorBox": [jnp.asarray(prior)], "PriorBoxVar": [jnp.asarray(var)],
+              "TargetBox": [jnp.asarray(diag.reshape(1, 2, 4))]},
+        {"code_type": "decode_center_size"})["OutputBox"][0]
+    np.testing.assert_allclose(np.asarray(dec).reshape(2, 4), target,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_roi_pool():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.asarray([[0, 0, 0, 1, 1]], np.float32)  # 2x2 region from (0,0)
+    ctx = executor_core.OpContext(eager=True)
+    got = run_op("roi_pool")(
+        ctx, {"X": [jnp.asarray(x)], "ROIs": [jnp.asarray(rois)]},
+        {"pooled_height": 1, "pooled_width": 1, "spatial_scale": 1.0})
+    # max over the 2x2 top-left block {0,1,4,5} = 5
+    assert float(np.asarray(got["Out"][0]).reshape(())) == 5.0
+
+
+# ---------------------------------------------------------------------------
+# nn stragglers
+# ---------------------------------------------------------------------------
+def test_lrn():
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 6, 3, 3).astype(np.float32)
+    n, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+    sq = np.zeros_like(x)
+    half = n // 2
+    C = x.shape[1]
+    for c in range(C):
+        lo, hi = max(0, c - half), min(C, c + half + 1)
+        sq[:, c] = (x[:, lo:hi] ** 2).sum(axis=1)
+    want = x / np.power(k + alpha * sq, beta)
+    _T("lrn", {"X": x}, {"Out": want.astype(np.float32)},
+       {"n": n, "k": k, "alpha": alpha, "beta": beta}).check_output(atol=1e-4)
+
+
+def test_prelu_and_grad():
+    rng = np.random.RandomState(2)
+    x = rng.randn(3, 4).astype(np.float32)
+    x[np.abs(x) < 0.2] += 0.5  # away from the kink
+    alpha = np.asarray([0.25], np.float32)
+    want = np.where(x > 0, x, alpha * x)
+    t = _T("prelu", {"X": x, "Alpha": alpha},
+           {"Out": want.astype(np.float32)})
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_maxout():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 6, 2, 2).astype(np.float32)
+    groups = 3
+    want = x.reshape(2, 2, groups, 2, 2).max(axis=2)
+    _T("maxout", {"X": x}, {"Out": want.astype(np.float32)},
+       {"groups": groups}).check_output()
+
+
+def test_bilinear_interp():
+    x = np.arange(8, dtype=np.float32).reshape(1, 2, 2, 2)
+    ctx = executor_core.OpContext(eager=True)
+    got = run_op("bilinear_interp")(
+        ctx, {"X": [jnp.asarray(x)], "OutSize": [None]},
+        {"out_h": 4, "out_w": 4})["Out"][0]
+    got = np.asarray(got)
+    assert got.shape == (1, 2, 4, 4)
+    # corners preserved, values within input range, monotone rows
+    np.testing.assert_allclose(got[0, 0, 0, 0], x[0, 0, 0, 0], atol=1e-5)
+    assert got.min() >= x.min() - 1e-5 and got.max() <= x.max() + 1e-5
+
+
+def test_conv2d_transpose_shape_and_adjoint():
+    """conv2d_transpose must be the adjoint of conv2d: <conv(x), y> ==
+    <x, conv_T(y)> for matching filters."""
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)  # [O, I, kh, kw]
+    ctx = executor_core.OpContext(eager=True)
+    y = run_op("conv2d")(
+        ctx, {"Input": [jnp.asarray(x)], "Filter": [jnp.asarray(w)]},
+        {"strides": [1, 1], "paddings": [0, 0],
+         "dilations": [1, 1]})["Output"][0]
+    cot = rng.randn(*np.asarray(y).shape).astype(np.float32)
+    # transpose conv filter layout: [I_of_transpose=O_of_fwd, O, kh, kw]
+    xt = run_op("conv2d_transpose")(
+        ctx, {"Input": [jnp.asarray(cot)], "Filter": [jnp.asarray(w)]},
+        {"strides": [1, 1], "paddings": [0, 0],
+         "dilations": [1, 1]})["Output"][0]
+    lhs = float((np.asarray(y) * cot).sum())
+    rhs = float((np.asarray(xt) * x).sum())
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-3)
+
+
+def test_conv3d():
+    rng = np.random.RandomState(5)
+    x = rng.randn(1, 1, 3, 3, 3).astype(np.float32)
+    w = rng.randn(2, 1, 2, 2, 2).astype(np.float32)
+    ctx = executor_core.OpContext(eager=True)
+    got = run_op("conv3d")(
+        ctx, {"Input": [jnp.asarray(x)], "Filter": [jnp.asarray(w)]},
+        {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+         "dilations": [1, 1, 1]})["Output"][0]
+    got = np.asarray(got)
+    assert got.shape == (1, 2, 2, 2, 2)
+    # spot check one output element against the direct correlation
+    want = (x[0, 0, :2, :2, :2] * w[0, 0]).sum()
+    np.testing.assert_allclose(got[0, 0, 0, 0, 0], want, rtol=1e-4)

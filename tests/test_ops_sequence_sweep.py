@@ -1,0 +1,234 @@
+"""Sequence-op sweep over ragged (LoD) inputs.
+
+Reference: unittests/test_seq_pool.py, test_sequence_softmax_op.py,
+test_sequence_expand.py, test_sequence_concat_op.py, test_seq_conv.py,
+test_sequence_reshape.py, test_sequence_slice_op.py,
+test_sequence_erase_op.py, test_row_conv_op.py, test_im2sequence_op.py.
+
+LoD specs use offsets form ([[0, 3, 5]] = lengths [3, 2]); inputs fill the
+full token capacity so dense comparisons need no padding bookkeeping,
+except where the op itself shrinks lengths (slice/erase) — there the
+expected tail padding is zeros by kernel contract.
+"""
+
+import numpy as np
+import pytest
+
+
+def run_op(op_type):
+    """Kernel entry via registry.run_kernel (tracked, AMP-aware)."""
+    from paddle_tpu.core import registry
+
+    d = registry.lookup(op_type)
+    return lambda ctx, ins, attrs: registry.run_kernel(d, ctx, ins, attrs)
+
+
+from op_test import OpTest
+
+
+class _T(OpTest):
+    def __init__(self, op_type, inputs, outputs, attrs=None, atol=None):
+        self.op_type = op_type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs or {}
+        if atol is not None:
+            self.atol = atol
+
+    def setup(self):
+        pass
+
+
+LOD = [[0, 3, 5]]  # lengths [3, 2]
+
+
+def _x(rng, d=4):
+    return rng.randn(5, d).astype(np.float32)
+
+
+def test_sequence_pool_all_types():
+    rng = np.random.RandomState(0)
+    x = _x(rng)
+    segs = [x[0:3], x[3:5]]
+    for ptype, ref in [
+        ("SUM", np.stack([s.sum(0) for s in segs])),
+        ("AVERAGE", np.stack([s.mean(0) for s in segs])),
+        ("SQRT", np.stack([s.sum(0) / np.sqrt(len(s)) for s in segs])),
+        ("MAX", np.stack([s.max(0) for s in segs])),
+        ("FIRST", np.stack([s[0] for s in segs])),
+        ("LAST", np.stack([s[-1] for s in segs])),
+    ]:
+        _T("sequence_pool", {"X": (x, LOD)},
+           {"Out": ref.astype(np.float32)},
+           {"pooltype": ptype}).check_output(atol=1e-5)
+
+
+def test_sequence_pool_grad():
+    rng = np.random.RandomState(1)
+    x = _x(rng)
+    segs = [x[0:3], x[3:5]]
+    t = _T("sequence_pool", {"X": (x, LOD)},
+           {"Out": np.stack([s.sum(0) for s in segs])},
+           {"pooltype": "SUM"})
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_sequence_softmax():
+    rng = np.random.RandomState(2)
+    x = rng.randn(5, 1).astype(np.float32)
+
+    def sm(v):
+        e = np.exp(v - v.max())
+        return e / e.sum()
+
+    want = np.concatenate([sm(x[0:3, 0]), sm(x[3:5, 0])]).reshape(5, 1)
+    _T("sequence_softmax", {"X": (x, LOD)},
+       {"Out": (want.astype(np.float32), LOD)}).check_output(atol=1e-5)
+
+
+def test_sequence_expand():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3).astype(np.float32)  # one row per sequence
+    y = np.zeros((5, 1), np.float32)
+    want = np.concatenate([np.tile(x[0], (3, 1)), np.tile(x[1], (2, 1))])
+    _T("sequence_expand", {"X": x, "Y": (y, LOD)},
+       {"Out": (want.astype(np.float32), LOD)}).check_output()
+
+
+def test_sequence_concat_feature_axis():
+    rng = np.random.RandomState(4)
+    a = _x(rng, 2)
+    b = _x(rng, 3)
+    want = np.concatenate([a, b], axis=1)
+    _T("sequence_concat",
+       {"X": [("a", (a, LOD)), ("b", (b, LOD))]},
+       {"Out": (want, LOD)}, {"axis": 1}).check_output()
+
+
+def test_sequence_concat_time_axis():
+    rng = np.random.RandomState(5)
+    a = _x(rng, 2)
+    b = rng.randn(4, 2).astype(np.float32)
+    lod_b = [[0, 1, 4]]
+    want = np.concatenate([a[0:3], b[0:1], a[3:5], b[1:4]])
+    _T("sequence_concat",
+       {"X": [("a", (a, LOD)), ("b", (b, lod_b))]},
+       {"Out": (want, [[0, 4, 9]])}, {"axis": 0}).check_output()
+
+
+def test_sequence_conv_and_grad():
+    rng = np.random.RandomState(6)
+    x = _x(rng, 3)
+    ctx_len, ctx_start = 3, -1
+    w = rng.randn(ctx_len * 3, 2).astype(np.float32) * 0.3
+
+    # numpy reference: per-sequence context window with zero boundary
+    def ref_one(seq):
+        n = seq.shape[0]
+        cols = []
+        for j in range(ctx_len):
+            off = ctx_start + j
+            rows = np.zeros_like(seq)
+            for i in range(n):
+                if 0 <= i + off < n:
+                    rows[i] = seq[i + off]
+            cols.append(rows)
+        return np.concatenate(cols, axis=1) @ w
+
+    want = np.concatenate([ref_one(x[0:3]), ref_one(x[3:5])])
+    t = _T("sequence_conv", {"X": (x, LOD), "Filter": w},
+           {"Out": (want.astype(np.float32), LOD)},
+           {"contextLength": ctx_len, "contextStart": ctx_start})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "Filter"], "Out", max_relative_error=0.01)
+
+
+def test_sequence_reshape():
+    rng = np.random.RandomState(7)
+    x = rng.randn(4, 6).astype(np.float32)
+    lod = [[0, 2, 4]]
+    want = x.reshape(8, 3)
+    _T("sequence_reshape", {"X": (x, lod)},
+       {"Out": (want, [[0, 4, 8]])}, {"new_dim": 3}).check_output()
+
+
+def test_sequence_slice():
+    rng = np.random.RandomState(8)
+    x = _x(rng, 2)
+    offset = np.asarray([[1], [0]], np.int64)
+    length = np.asarray([[2], [1]], np.int64)
+    want = np.zeros_like(x)[:5]
+    want[0:2] = x[1:3]   # seq0[1:3]
+    want[2] = x[3]       # seq1[0:1]
+    _T("sequence_slice",
+       {"X": (x, LOD), "Offset": offset, "Length": length},
+       {"Out": (want[:5], [[0, 2, 3]])}).check_output()
+
+
+def test_sequence_erase():
+    x = np.asarray([[1], [2], [9], [9], [3]], np.int32)
+    want = np.asarray([[1], [2], [3], [0], [0]], np.int32)
+    _T("sequence_erase", {"X": (x, LOD)},
+       {"Out": (want, [[0, 2, 3]])}, {"tokens": [9]}).check_output()
+
+
+def test_sequence_pad_unpad_roundtrip():
+    rng = np.random.RandomState(9)
+    x = _x(rng, 3)
+    padded = np.zeros((2, 3, 3), np.float32)
+    padded[0, :3] = x[0:3]
+    padded[1, :2] = x[3:5]
+    _T("sequence_pad", {"X": (x, LOD)},
+       {"Out": padded, "Length": np.asarray([3, 2], np.int32)},
+       {"padded_length": 3}).check_output(no_check_set=("Length",))
+
+    from paddle_tpu.core import executor_core
+    from paddle_tpu.core.registry import lookup
+    import jax.numpy as jnp
+
+    ctx = executor_core.OpContext(eager=True)
+    back = run_op("sequence_unpad")(
+        ctx, {"X": [jnp.asarray(padded)],
+              "Length": [jnp.asarray([3, 2], jnp.int32)]},
+        {"ntokens": 5})["Out"][0]
+    np.testing.assert_allclose(np.asarray(back.data), x, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(back.lengths), [3, 2])
+
+
+def test_row_conv():
+    rng = np.random.RandomState(10)
+    x = _x(rng, 2)
+    future = 2
+    w = rng.randn(future, 2).astype(np.float32)
+
+    def ref_one(seq):
+        n = seq.shape[0]
+        o = np.zeros_like(seq)
+        for i in range(n):
+            for j in range(future):
+                if i + j < n:
+                    o[i] += seq[i + j] * w[j]
+        return o
+
+    want = np.concatenate([ref_one(x[0:3]), ref_one(x[3:5])])
+    _T("row_conv", {"X": (x, LOD), "Filter": w},
+       {"Out": (want.astype(np.float32), LOD)}).check_output(atol=1e-5)
+
+
+def test_im2sequence():
+    rng = np.random.RandomState(11)
+    x = rng.randn(2, 1, 4, 4).astype(np.float32)
+    kh = kw = 2
+    sh = sw = 2
+
+    def patches(img):
+        rows = []
+        for i in range(0, 4 - kh + 1, sh):
+            for j in range(0, 4 - kw + 1, sw):
+                rows.append(img[:, i:i + kh, j:j + kw].reshape(-1))
+        return np.stack(rows)
+
+    want = np.concatenate([patches(x[0]), patches(x[1])])
+    _T("im2sequence", {"X": x},
+       {"Out": (want.astype(np.float32), [[0, 4, 8]])},
+       {"kernels": [kh, kw], "strides": [sh, sw]}).check_output(atol=1e-5)

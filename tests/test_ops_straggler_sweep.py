@@ -1,0 +1,518 @@
+"""Stragglers toward full-registry op coverage: RNN units/dynamic RNNs,
+adadelta, reduce_min, host/control ops (feed/fetch/assert/get_places/
+delete_var), and the reader creators not covered by the recordio pipeline
+test (shuffle / multi-pass / random-data-generator / open_files).
+
+Reference: unittests/test_lstm_op.py, test_gru_op.py, test_gru_unit_op.py,
+test_lstm_unit_op.py, test_adadelta_op.py, test_reduce_op.py,
+test_multi_pass_reader.py, test_shuffle_reader.py.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import recordio
+from paddle_tpu.core.framework import Program, program_guard
+from op_test import OpTest
+
+
+def run_op(op_type):
+    from paddle_tpu.core import registry
+
+    d = registry.lookup(op_type)
+    return lambda ctx, ins, attrs: registry.run_kernel(d, ctx, ins, attrs)
+
+
+def _ctx():
+    from paddle_tpu.core import executor_core
+
+    return executor_core.OpContext(eager=True)
+
+
+class _T(OpTest):
+    def __init__(self, op_type, inputs, outputs, attrs=None, atol=None):
+        self.op_type = op_type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs or {}
+        if atol is not None:
+            self.atol = atol
+
+    def setup(self):
+        pass
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+# ---------------------------------------------------------------------------
+# RNN units + dynamic RNNs
+# ---------------------------------------------------------------------------
+def test_lstm_unit():
+    rng = np.random.RandomState(0)
+    B, D = 3, 4
+    x = rng.randn(B, 4 * D).astype(np.float32)
+    c_prev = rng.randn(B, D).astype(np.float32)
+    fb = 0.5
+    i_g, f_g, c_g, o_g = np.split(x, 4, axis=-1)
+    c = _sigmoid(f_g + fb) * c_prev + _sigmoid(i_g) * np.tanh(c_g)
+    h = _sigmoid(o_g) * np.tanh(c)
+    t = _T("lstm_unit", {"X": x, "C_prev": c_prev},
+           {"C": c.astype(np.float32), "H": h.astype(np.float32)},
+           {"forget_bias": fb})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "C_prev"], ["H"], max_relative_error=0.01)
+
+
+def test_gru_unit():
+    rng = np.random.RandomState(1)
+    B, D = 3, 4
+    x = rng.randn(B, 3 * D).astype(np.float32)
+    h_prev = rng.randn(B, D).astype(np.float32)
+    w = rng.randn(D, 3 * D).astype(np.float32) * 0.3
+    bias = rng.randn(1, 3 * D).astype(np.float32) * 0.1
+    g = x + bias
+    ur = _sigmoid(g[:, :2 * D] + h_prev @ w[:, :2 * D])
+    u, r = np.split(ur, 2, axis=-1)
+    reset_h = r * h_prev
+    c = np.tanh(g[:, 2 * D:] + reset_h @ w[:, 2 * D:])
+    h = u * h_prev + (1 - u) * c
+    t = _T("gru_unit",
+           {"Input": x, "HiddenPrev": h_prev, "Weight": w, "Bias": bias},
+           {"Hidden": h.astype(np.float32)},
+           {"gate_activation": 1, "activation": 2})
+    t.check_output(no_check_set=("Gate", "ResetHiddenPrev"), atol=1e-5)
+
+
+def _np_lstm(xp, lengths, w, bias, D):
+    """Dynamic LSTM reference on padded [B,T,4D]."""
+    B, T = xp.shape[0], xp.shape[1]
+    h = np.zeros((B, D), np.float32)
+    c = np.zeros((B, D), np.float32)
+    hs = np.zeros((B, T, D), np.float32)
+    cs = np.zeros((B, T, D), np.float32)
+    for t in range(T):
+        gates = xp[:, t] + h @ w + bias[:, :4 * D]
+        i_g, f_g, c_g, o_g = np.split(gates, 4, axis=-1)
+        i, f, o = _sigmoid(i_g), _sigmoid(f_g), _sigmoid(o_g)
+        c_new = f * c + i * np.tanh(c_g)
+        h_new = o * np.tanh(c_new)
+        mask = (t < lengths)[:, None]
+        h = np.where(mask, h_new, h)
+        c = np.where(mask, c_new, c)
+        hs[:, t] = h
+        cs[:, t] = c
+    return hs, cs
+
+
+def test_dynamic_lstm_matches_numpy():
+    rng = np.random.RandomState(2)
+    D = 3
+    lengths = np.asarray([3, 2], np.int32)
+    N = int(lengths.sum())
+    x = rng.randn(N, 4 * D).astype(np.float32) * 0.5
+    w = rng.randn(D, 4 * D).astype(np.float32) * 0.3
+    bias = rng.randn(1, 4 * D).astype(np.float32) * 0.1
+    xp = np.zeros((2, 3, 4 * D), np.float32)
+    xp[0, :3] = x[0:3]
+    xp[1, :2] = x[3:5]
+    hs, _ = _np_lstm(xp, lengths, w, bias, D)
+    want = np.concatenate([hs[0, :3], hs[1, :2]])
+    t = _T("lstm", {"Input": (x, [[0, 3, 5]]), "Weight": w, "Bias": bias},
+           {"Hidden": (want.astype(np.float32), [[0, 3, 5]])},
+           {"use_peepholes": False})
+    t.check_output(no_check_set=("Cell",), atol=1e-5)
+
+
+def test_dynamic_gru_matches_numpy():
+    rng = np.random.RandomState(3)
+    D = 3
+    lengths = np.asarray([2, 3], np.int32)
+    N = 5
+    x = rng.randn(N, 3 * D).astype(np.float32) * 0.5
+    w = rng.randn(D, 3 * D).astype(np.float32) * 0.3
+    bias = rng.randn(1, 3 * D).astype(np.float32) * 0.1
+    xp = np.zeros((2, 3, 3 * D), np.float32)
+    xp[0, :2] = x[0:2]
+    xp[1, :3] = x[2:5]
+    h = np.zeros((2, D), np.float32)
+    hs = np.zeros((2, 3, D), np.float32)
+    for t in range(3):
+        g = xp[:, t] + bias
+        ur = _sigmoid(g[:, :2 * D] + h @ w[:, :2 * D])
+        u, r = np.split(ur, 2, axis=-1)
+        c = np.tanh(g[:, 2 * D:] + (r * h) @ w[:, 2 * D:])
+        h_new = u * h + (1 - u) * c
+        mask = (t < lengths)[:, None]
+        h = np.where(mask, h_new, h)
+        hs[:, t] = h
+    want = np.concatenate([hs[0, :2], hs[1, :3]])
+    t = _T("gru", {"Input": (x, [[0, 2, 5]]), "Weight": w, "Bias": bias},
+           {"Hidden": (want.astype(np.float32), [[0, 2, 5]])}, {})
+    t.check_output(atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# optimizer + reduce stragglers
+# ---------------------------------------------------------------------------
+def test_adadelta():
+    rng = np.random.RandomState(4)
+    p = rng.randn(4, 3).astype(np.float32)
+    g = rng.randn(4, 3).astype(np.float32)
+    asg = np.abs(rng.randn(4, 3)).astype(np.float32)
+    asu = np.abs(rng.randn(4, 3)).astype(np.float32)
+    rho, eps = 0.95, 1e-6
+    asg_out = rho * asg + (1 - rho) * g * g
+    upd = -np.sqrt((asu + eps) / (asg_out + eps)) * g
+    asu_out = rho * asu + (1 - rho) * upd * upd
+    _T("adadelta",
+       {"Param": p, "Grad": g, "AvgSquaredGrad": asg,
+        "AvgSquaredUpdate": asu},
+       {"ParamOut": (p + upd).astype(np.float32),
+        "AvgSquaredGradOut": asg_out.astype(np.float32),
+        "AvgSquaredUpdateOut": asu_out.astype(np.float32)},
+       {"rho": rho, "epsilon": eps}).check_output(atol=1e-5)
+
+
+def test_reduce_min():
+    rng = np.random.RandomState(5)
+    x = rng.randn(3, 5).astype(np.float32)
+    _T("reduce_min", {"X": x}, {"Out": x.min(axis=1)},
+       {"dim": 1}).check_output()
+
+
+# ---------------------------------------------------------------------------
+# host / control ops
+# ---------------------------------------------------------------------------
+def test_feed_fetch_assert_get_places_delete_var():
+    from paddle_tpu.core import executor_core
+
+    ctx = executor_core.OpContext(eager=True, feed={"x": np.ones((2,))})
+
+    class _FakeOp:
+        type = "feed"
+
+        def output(self, slot):
+            return ["x"]
+
+        def input(self, slot):
+            return ["x"]
+
+    ctx.current_op = _FakeOp()
+    got = run_op("feed")(ctx, {}, {"col": 0})["Out"][0]
+    np.testing.assert_allclose(np.asarray(got), np.ones((2,)))
+
+    run_op("fetch")(ctx, {"X": [np.full((2,), 3.0)]}, {})
+    assert len(ctx.fetch_sink) == 1
+    np.testing.assert_allclose(np.asarray(ctx.fetch_sink[0]), 3.0)
+
+    assert run_op("assert_op")(ctx, {"Cond": [np.asarray(True)]}, {}) == {}
+
+    places = run_op("get_places")(
+        ctx, {}, {"device_count": 3, "device_type": "CPU"})["Out"]
+    assert len(places) == 3
+
+    ctx.env = {"victim": np.ones(1)}
+    ctx.scope = fluid.Scope()
+    ctx.scope.var("victim")
+    ctx.scope.set_var("victim", np.ones(1))
+
+    class _DelOp:
+        type = "delete_var"
+
+        def input(self, slot):
+            return ["victim"]
+
+    ctx.current_op = _DelOp()
+    run_op("delete_var")(ctx, {"X": [np.ones(1)]}, {})
+    assert "victim" not in ctx.env
+    assert ctx.scope.find_var("victim") is None
+
+
+# ---------------------------------------------------------------------------
+# reader creators
+# ---------------------------------------------------------------------------
+def _write_rio(path, n=12, seed=0):
+    rs = np.random.RandomState(seed)
+    with recordio.Writer(path) as w:
+        for i in range(n):
+            x = np.full((2,), float(i), np.float32)
+            w.write(pickle.dumps([(x, None)]))
+
+
+def _drain(reader_var, exe, prog, fetch):
+    vals = []
+    while True:
+        try:
+            out, = exe.run(prog, fetch_list=[fetch])
+        except Exception:
+            break
+        v = np.asarray(out)
+        if v.size == 0:
+            break
+        vals.append(v)
+    return vals
+
+
+def test_shuffle_reader_permutes_all_records(tmp_path):
+    path = str(tmp_path / "s.rio")
+    _write_rio(path, n=12)
+    with program_guard(Program(), Program()):
+        reader = fluid.layers.open_recordio_file(
+            path, shapes=[[-1, 2]], lod_levels=[0], dtypes=["float32"])
+        reader = fluid.layers.shuffle(reader, buffer_size=8)
+        x = fluid.layers.read_file(reader)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        seen = []
+        for _ in range(12):
+            out, = exe.run(fetch_list=[x])
+            seen.append(float(np.asarray(out).reshape(-1)[0]))
+    assert sorted(seen) == [float(i) for i in range(12)]
+
+
+def test_multi_pass_reader(tmp_path):
+    path = str(tmp_path / "m.rio")
+    _write_rio(path, n=4)
+    with program_guard(Program(), Program()):
+        reader = fluid.layers.open_recordio_file(
+            path, shapes=[[-1, 2]], lod_levels=[0], dtypes=["float32"])
+        reader = fluid.layers.multi_pass(reader, pass_num=3)
+        x = fluid.layers.read_file(reader)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        count = 0
+        for _ in range(12):
+            exe.run(fetch_list=[x])
+            count += 1
+    assert count == 12  # 4 records x 3 passes
+
+
+def test_random_data_generator():
+    with program_guard(Program(), Program()):
+        reader = fluid.layers.random_data_generator(
+            low=0.0, high=1.0, shapes=[[4, 3]], lod_levels=[0])
+        x = fluid.layers.read_file(reader)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        out, = exe.run(fetch_list=[x])
+        v = np.asarray(out)
+    assert v.shape == (4, 3)
+    assert v.min() >= 0.0 and v.max() <= 1.0
+
+
+def test_open_files_round_robin(tmp_path):
+    p1 = str(tmp_path / "a.rio")
+    p2 = str(tmp_path / "b.rio")
+    _write_rio(p1, n=3, seed=1)
+    _write_rio(p2, n=3, seed=2)
+    with program_guard(Program(), Program()):
+        reader = fluid.layers.open_files(
+            [p1, p2], shapes=[[-1, 2]], lod_levels=[0], dtypes=["float32"])
+        x = fluid.layers.read_file(reader)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        vals = []
+        for _ in range(6):
+            out, = exe.run(fetch_list=[x])
+            vals.append(float(np.asarray(out).reshape(-1)[0]))
+    assert sorted(vals) == [0.0, 0.0, 1.0, 1.0, 2.0, 2.0]
+
+
+# ---------------------------------------------------------------------------
+# LoD bucketing helpers + control-flow RNNs + Switch/ConditionalBlock
+# ---------------------------------------------------------------------------
+def test_lod_rank_table_array_roundtrip():
+    """lod_rank_table -> max_sequence_len -> lod_tensor_to_array ->
+    lod_array_length -> array_to_lod_tensor reproduces the input (the
+    DynamicRNN bucketing machinery, reference lod_rank_table.cc +
+    lod_tensor_to_array_op.cc)."""
+    x_np = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lod = [[0, 2, 5]]  # lengths [2, 3]
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        mlen = fluid.layers.max_sequence_len(table)
+        arr = fluid.layers.lod_tensor_to_array(x, table)
+        alen = fluid.layers.array_length(arr) if hasattr(
+            fluid.layers, "array_length") else None
+        back = fluid.layers.array_to_lod_tensor(arr, table)
+        exe = fluid.Executor(fluid.CPUPlace())
+        lt = fluid.create_lod_tensor(x_np, [[2, 3]], fluid.CPUPlace())
+        fetches = [mlen, back]
+        out = exe.run(feed={"x": lt}, fetch_list=fetches,
+                      return_numpy=False)
+    assert int(np.asarray(out[0]).reshape(())) == 3
+    np.testing.assert_allclose(np.asarray(out[1]), x_np, rtol=1e-6)
+
+
+def test_shrink_rnn_memory():
+    """shrink_memory keeps the first B_t rows (sequences still alive at
+    step t, rank-table order)."""
+    x_np = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lod = [[2, 3]]
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        mem = fluid.layers.data(name="mem", shape=[2], dtype="float32")
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=2)
+        shrunk = fluid.layers.shrink_memory(mem, i, table)
+        exe = fluid.Executor(fluid.CPUPlace())
+        lt = fluid.create_lod_tensor(x_np, lod, fluid.CPUPlace())
+        mem_np = np.arange(4, dtype=np.float32).reshape(2, 2)
+        out, = exe.run(feed={"x": lt, "mem": mem_np}, fetch_list=[shrunk],
+                       return_numpy=False)
+    got = np.asarray(out)
+    # at t=2 only the length-3 sequence is alive -> 1 row survives
+    assert got.shape[0] == 1
+    np.testing.assert_allclose(got[0], mem_np[0])
+
+
+def test_static_rnn_prefix_sum():
+    T, B, D = 4, 2, 3
+    x_np = np.ones((T, B, D), np.float32)
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[T, B, D],
+                              append_batch_size=False, dtype="float32")
+        init = fluid.layers.fill_constant(shape=[B, D], dtype="float32",
+                                          value=0.0)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h = rnn.memory(init=init)
+            nh = fluid.layers.elementwise_add(h, x_t)
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        out = rnn()
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(feed={"x": x_np}, fetch_list=[out])
+    got = np.asarray(got)
+    want = np.cumsum(x_np, axis=0)
+    np.testing.assert_allclose(got.reshape(want.shape), want, rtol=1e-6)
+
+
+def test_dynamic_rnn_prefix_sum():
+    x_np = np.arange(10, dtype=np.float32).reshape(5, 2)
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=1)
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            w = drnn.step_input(x)
+            mem = drnn.memory(shape=[2], value=0.0)
+            nh = fluid.layers.elementwise_add(w, mem)
+            drnn.update_memory(mem, nh)
+            drnn.output(nh)
+        out = drnn()
+        exe = fluid.Executor(fluid.CPUPlace())
+        lt = fluid.create_lod_tensor(x_np, [[2, 3]], fluid.CPUPlace())
+        got, = exe.run(feed={"x": lt}, fetch_list=[out],
+                       return_numpy=False)
+    got = np.asarray(got)
+    want = np.concatenate([np.cumsum(x_np[0:2], axis=0),
+                           np.cumsum(x_np[2:5], axis=0)])
+    np.testing.assert_allclose(got.reshape(want.shape), want, rtol=1e-6)
+
+
+def test_switch_conditional_block():
+    """Switch lowers to conditional_block ops (reference Switch:1126)."""
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32")
+        one = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                         value=1.0)
+        res = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                         value=-1.0)
+        cond = fluid.layers.less_than(x=x, y=one)
+        sw = fluid.layers.Switch()
+        with sw:
+            with sw.case(cond):
+                fluid.layers.assign(fluid.layers.fill_constant(
+                    shape=[1], dtype="float32", value=10.0), res)
+            with sw.default():
+                fluid.layers.assign(fluid.layers.fill_constant(
+                    shape=[1], dtype="float32", value=20.0), res)
+        exe = fluid.Executor(fluid.CPUPlace())
+        lo, = exe.run(feed={"x": np.asarray([[0.5]], np.float32)},
+                      fetch_list=[res])
+        hi, = exe.run(feed={"x": np.asarray([[2.0]], np.float32)},
+                      fetch_list=[res])
+    assert float(np.asarray(lo).reshape(())) == 10.0
+    assert float(np.asarray(hi).reshape(())) == 20.0
+
+
+def test_print_op_passthrough(capfd):
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        y = fluid.layers.Print(x, message="dbg", summarize=2)
+        z = fluid.layers.scale(y, scale=2.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        out, = exe.run(feed={"x": np.ones((1, 3), np.float32)},
+                       fetch_list=[z])
+    np.testing.assert_allclose(np.asarray(out), 2 * np.ones((1, 3)))
+
+
+def test_save_load_combine_roundtrip(tmp_path):
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3,
+                            param_attr=fluid.ParamAttr(name="cw"))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        w0 = np.array(fluid.executor.fetch_var("cw"))
+        fluid.io.save_persistables(exe, str(tmp_path),
+                                   filename="all_params.bin")
+        assert os.path.exists(str(tmp_path / "all_params.bin"))
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            fluid.io.load_persistables(exe, str(tmp_path),
+                                       filename="all_params.bin")
+            w1 = np.array(fluid.executor.fetch_var("cw", scope=scope2))
+    np.testing.assert_allclose(w0, w1)
+
+
+def test_combined_send_op():
+    """The combined send op (grads + barriers + param fetch in one op,
+    reference send_op.cc:29) against a live variable server."""
+    from paddle_tpu.parallel import rpc as rpc_runtime
+    from paddle_tpu.core import executor_core
+    from paddle_tpu.ops import rpc_ops
+
+    store = {"W": np.ones((2, 2), np.float32)}
+
+    def on_round(received):
+        store["W"] = store["W"] - 0.1 * store["g"]
+
+    server = rpc_runtime.VariableServer(
+        num_trainers=1, get_var=lambda n: store[n],
+        put_var=store.__setitem__, on_round=on_round)
+    server.start()
+    try:
+        ep = f"127.0.0.1:{server.port}"
+        ctx = executor_core.OpContext(eager=True)
+        ctx.env = {"g": np.full((2, 2), 2.0, np.float32)}
+        ctx.scope = None
+
+        class _SendOp:
+            type = "send"
+
+            def input(self, slot):
+                return ["g"]
+
+            def output(self, slot):
+                return ["W"]
+
+        ctx.current_op = _SendOp()
+        res = run_op("send")(
+            ctx, {"X": [ctx.env["g"]]}, {"epmap": [ep]})
+        got = np.asarray(res["Out"][0])
+        np.testing.assert_allclose(got, np.ones((2, 2)) - 0.2)
+    finally:
+        server.stop()
+        rpc_ops.reset_clients()

@@ -1,0 +1,210 @@
+"""Tensor/data-movement op sweep: gather/scatter/pad/crop/one_hot/
+multiplex/argsort/arg_max/reverse/expand/label_smooth/transpose/split/
+fill_* /assign/random generators/norm family.
+
+Reference: the corresponding unittests/test_<op>_op.py files.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def run_op(op_type):
+    """Kernel entry via registry.run_kernel (tracked, AMP-aware)."""
+    from paddle_tpu.core import registry
+
+    d = registry.lookup(op_type)
+    return lambda ctx, ins, attrs: registry.run_kernel(d, ctx, ins, attrs)
+
+from op_test import OpTest
+
+
+class _T(OpTest):
+    """Inline OpTest: pass everything to the constructor."""
+
+    def __init__(self, op_type, inputs, outputs, attrs=None, atol=None):
+        self.op_type = op_type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs or {}
+        if atol is not None:
+            self.atol = atol
+
+    def setup(self):
+        pass
+
+
+def test_gather_output_and_grad():
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 3).astype(np.float32)
+    idx = np.array([1, 3, 5], np.int32)
+    t = _T("gather", {"X": x, "Index": idx}, {"Out": x[idx]})
+    t.check_output()
+    t.check_grad(["X"], "Out", no_grad_set={"Index"})
+
+
+def test_scatter():
+    rng = np.random.RandomState(1)
+    x = rng.randn(6, 2).astype(np.float32)
+    ids = np.array([0, 4], np.int32)
+    upd = rng.randn(2, 2).astype(np.float32)
+    want = x.copy()
+    want[ids] = upd
+    _T("scatter", {"X": x, "Ids": ids, "Updates": upd},
+       {"Out": want}).check_output()
+
+
+def test_pad_and_crop():
+    rng = np.random.RandomState(2)
+    x = rng.randn(3, 4).astype(np.float32)
+    want = np.pad(x, [(1, 0), (2, 1)], constant_values=0.5)
+    t = _T("pad", {"X": x}, {"Out": want},
+           {"paddings": [1, 0, 2, 1], "pad_value": 0.5})
+    t.check_output()
+    t.check_grad(["X"], "Out")
+
+    big = rng.randn(5, 6).astype(np.float32)
+    t2 = _T("crop", {"X": big}, {"Out": big[1:4, 2:5]},
+            {"offsets": [1, 2], "shape": [3, 3]})
+    t2.check_output()
+    t2.check_grad(["X"], "Out")
+
+
+def test_one_hot():
+    x = np.array([[1], [0], [3]], np.int64)
+    want = np.eye(4, dtype=np.float32)[x.reshape(-1)]
+    _T("one_hot", {"X": x}, {"Out": want}, {"depth": 4}).check_output()
+
+
+def test_multiplex():
+    rng = np.random.RandomState(3)
+    xs = [rng.randn(4, 3).astype(np.float32) for _ in range(3)]
+    ids = np.array([[2], [0], [1], [2]], np.int32)
+    want = np.stack([xs[int(k)][i] for i, k in enumerate(ids.reshape(-1))])
+    _T("multiplex",
+       {"Ids": ids, "X": [(f"x{i}", x) for i, x in enumerate(xs)]},
+       {"Out": want}).check_output()
+
+
+def test_argsort_argmax_argmin():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 5).astype(np.float32)
+    idx = np.argsort(x, axis=-1)
+    _T("argsort", {"X": x},
+       {"Out": np.sort(x, axis=-1), "Indices": idx.astype(np.int64)},
+       {"axis": -1}).check_output()
+    _T("arg_max", {"X": x},
+       {"Out": np.argmax(x, axis=-1).astype(np.int64)}).check_output()
+    _T("arg_min", {"X": x},
+       {"Out": np.argmin(x, axis=-1).astype(np.int64)}).check_output()
+
+
+def test_reverse_expand_transpose_split():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 3).astype(np.float32)
+    _T("reverse", {"X": x}, {"Out": x[::-1]}, {"axis": 0}).check_output()
+    _T("expand", {"X": x}, {"Out": np.tile(x, (2, 1))},
+       {"expand_times": [2, 1]}).check_output()
+    t = _T("transpose", {"X": x}, {"Out": x.T}, {"axis": [1, 0]})
+    t.check_output()
+    t.check_grad(["X"], "Out")
+    x2 = rng.randn(4, 6).astype(np.float32)
+    _T("split", {"X": x2},
+       {"Out": [("s0", x2[:, :2]), ("s1", x2[:, 2:4]), ("s2", x2[:, 4:])]},
+       {"num": 3, "axis": 1}).check_output()
+
+
+def test_label_smooth():
+    x = np.eye(3, dtype=np.float32)[[0, 2]]
+    eps = 0.1
+    want = (1 - eps) * x + eps / 3
+    _T("label_smooth", {"X": x}, {"Out": want},
+       {"epsilon": eps}).check_output()
+
+
+def test_fill_and_assign_ops():
+    _T("fill_constant", {}, {"Out": np.full((2, 3), 7.0, np.float32)},
+       {"shape": [2, 3], "value": 7.0, "dtype": "float32"}).check_output()
+    ref = np.zeros((5, 2), np.float32)
+    _T("fill_constant_batch_size_like", {"Input": ref},
+       {"Out": np.full((5, 4), 2.0, np.float32)},
+       {"shape": [-1, 4], "value": 2.0, "dtype": "float32"}).check_output()
+    x = np.ones((2, 2), np.float32)
+    _T("fill_zeros_like", {"X": x}, {"Out": np.zeros_like(x)}).check_output()
+    _T("assign", {"X": x}, {"Out": x}).check_output()
+    vals = [1.0, 2.0, 3.0, 4.0]
+    _T("assign_value", {}, {"Out": np.asarray(vals, np.float32).reshape(2, 2)},
+       {"values": vals, "shape": [2, 2], "dtype": "float32"}).check_output()
+
+
+def test_random_generators_statistics():
+    """uniform/gaussian/truncated: check moments + bounds, fixed seed."""
+    from paddle_tpu.core import executor_core, registry
+    from paddle_tpu.core.registry import lookup
+
+    ctx = executor_core.OpContext(eager=True)
+    u = run_op("uniform_random")(
+        ctx, {}, {"shape": [20000], "min": -2.0, "max": 2.0, "seed": 3})["Out"][0]
+    u = np.asarray(u)
+    assert u.min() >= -2.0 and u.max() <= 2.0
+    assert abs(u.mean()) < 0.05
+    g = run_op("gaussian_random")(
+        ctx, {}, {"shape": [20000], "mean": 1.0, "std": 2.0, "seed": 3})["Out"][0]
+    g = np.asarray(g)
+    assert abs(g.mean() - 1.0) < 0.06 and abs(g.std() - 2.0) < 0.06
+    t = run_op("truncated_gaussian_random")(
+        ctx, {}, {"shape": [20000], "mean": 0.0, "std": 1.0, "seed": 3})["Out"][0]
+    t = np.asarray(t)
+    assert t.min() >= -2.0 - 1e-5 and t.max() <= 2.0 + 1e-5
+
+
+def test_norm_family():
+    rng = np.random.RandomState(7)
+    x = rng.randn(3, 4).astype(np.float32) + 3.0
+    n = np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    t = _T("norm", {"X": x}, {"Out": x / n, "Norm": n}, {"axis": 1})
+    t.check_output(no_check_set=("Norm",))
+    _T("squared_l2_norm", {"X": x},
+       {"Out": np.asarray([(x ** 2).sum()], np.float32)}).check_output(
+        atol=1e-3)
+    y = rng.randn(3, 4).astype(np.float32)
+    _T("squared_l2_distance", {"X": x, "Y": y},
+       {"sub_result": x - y,
+        "Out": ((x - y) ** 2).sum(axis=1, keepdims=True)}).check_output(
+        atol=1e-4)
+    # clip_by_norm: scaling branch + identity branch
+    big = np.full((4,), 10.0, np.float32)
+    _T("clip_by_norm", {"X": big}, {"Out": big / 20.0 * 1.0},
+       {"max_norm": 1.0}).check_output()
+    small = np.full((4,), 0.1, np.float32)
+    _T("clip_by_norm", {"X": small}, {"Out": small},
+       {"max_norm": 1.0}).check_output()
+    xn = np.abs(rng.randn(3, 4)).astype(np.float32) + 0.5
+    yn = np.abs(rng.randn(3, 4)).astype(np.float32) + 0.5
+    cs = (xn * yn).sum(-1, keepdims=True) / (
+        np.linalg.norm(xn, axis=-1, keepdims=True)
+        * np.linalg.norm(yn, axis=-1, keepdims=True))
+    t = _T("cos_sim", {"X": xn, "Y": yn}, {"Out": cs.astype(np.float32)})
+    t.check_output(no_check_set=("XNorm", "YNorm"))
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+def test_shape_increment_cumsum():
+    x = np.ones((3, 5), np.float32)
+    _T("shape", {"X": x},
+       {"Out": np.asarray([3, 5], np.int64)}).check_output()
+    v = np.asarray([2.0], np.float32)
+    _T("increment", {"X": v}, {"Out": np.asarray([3.5], np.float32)},
+       {"step": 1.5}).check_output()
+    x2 = np.arange(6, dtype=np.float32).reshape(2, 3)
+    _T("cumsum", {"X": x2}, {"Out": np.cumsum(x2, axis=1)},
+       {"axis": 1}).check_output()
+
+
+def test_lod_reset():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    lengths = np.asarray([2, 4], np.int32)
+    t = _T("lod_reset", {"X": x, "Y": lengths}, {"Out": (x, [[0, 2, 6]])})
+    t.check_output()
