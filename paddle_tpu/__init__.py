@@ -84,6 +84,7 @@ from .trainer import Trainer, BeginEpochEvent, EndEpochEvent, BeginStepEvent, En
 from .inferencer import Inferencer
 from . import amp
 from . import flags
+from . import concurrency
 from . import transpiler
 from .transpiler import DistributeTranspiler, InferenceTranspiler, memory_optimize, release_memory
 from .unique_name import generate as _generate_unique_name
@@ -111,4 +112,5 @@ __all__ = [
     "Trainer", "Inferencer", "transpiler", "DistributeTranspiler",
     "InferenceTranspiler", "memory_optimize", "release_memory",
     "reader", "dataset", "batch", "unique_name", "parallel", "flags",
+    "concurrency",
 ]

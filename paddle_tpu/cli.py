@@ -1,0 +1,89 @@
+"""Command-line entry: `python -m paddle_tpu <command>`.
+
+Reference parity: the `paddle` wrapper script (paddle/scripts/
+submit_local.sh.in:1 — version/train subcommands that set up the cluster
+env and exec the user script) and the flag listing the reference scatters
+through gflags --help.
+
+Commands:
+  version            print version + backend info
+  flags              list registered runtime flags (FLAGS_* env overrides)
+  train SCRIPT ...   launch a training script with PADDLE_* cluster env
+                     (--role trainer|pserver --trainers N --trainer-id I
+                      --pservers host:port,...) — the same variables
+                     Trainer()'s cluster bootstrap reads.
+"""
+
+import argparse
+import os
+import sys
+
+
+def _cmd_version(args):
+    from . import __version__
+
+    print(f"paddle_tpu {__version__}")
+    try:
+        import jax
+
+        devs = jax.devices()
+        print(f"jax {jax.__version__}; {len(devs)} device(s): "
+              f"{devs[0].platform}")
+    except Exception as e:  # jax may be unusable in a build sandbox
+        print(f"jax unavailable: {e}")
+    return 0
+
+
+def _cmd_flags(args):
+    from . import flags
+
+    for name, (value, type_, help_) in flags.all_flags().items():
+        print(f"FLAGS_{name} ({type_}, current={value}): {help_}")
+    return 0
+
+
+def _cmd_train(args):
+    env = dict(os.environ)
+    env["PADDLE_TRAINING_ROLE"] = args.role.upper()
+    env["PADDLE_TRAINERS"] = str(args.trainers)
+    env["PADDLE_TRAINER_ID"] = str(args.trainer_id)
+    if args.pservers:
+        env["PADDLE_PSERVERS"] = args.pservers
+    if args.current_endpoint:
+        env["PADDLE_CURRENT_ENDPOINT"] = args.current_endpoint
+    cmd = [sys.executable, args.script] + args.script_args
+    os.execve(sys.executable, cmd, env)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="paddle_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version", help="print version and backend info")
+    sub.add_parser("flags", help="list runtime flags")
+
+    t = sub.add_parser("train", help="launch a training script with "
+                                     "cluster environment")
+    t.add_argument("--role", default="trainer",
+                   choices=["trainer", "pserver"])
+    t.add_argument("--trainers", type=int, default=1)
+    t.add_argument("--trainer-id", type=int, default=0)
+    t.add_argument("--pservers", default="",
+                   help="comma-separated host:port list")
+    t.add_argument("--current-endpoint", default="",
+                   help="this pserver's host:port")
+    t.add_argument("script")
+    t.add_argument("script_args", nargs=argparse.REMAINDER)
+
+    args = parser.parse_args(argv)
+    if args.command == "version":
+        return _cmd_version(args)
+    if args.command == "flags":
+        return _cmd_flags(args)
+    if args.command == "train":
+        return _cmd_train(args)
+    parser.error(f"unknown command {args.command}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

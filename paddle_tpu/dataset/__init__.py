@@ -12,8 +12,14 @@ from . import imdb
 from . import imikolov
 from . import uci_housing
 from . import wmt14
+from . import wmt16
 from . import flowers
 from . import movielens
+from . import conll05
+from . import sentiment
+from . import voc2012
+from . import mq2007
 
 __all__ = ["common", "mnist", "cifar", "imdb", "imikolov", "uci_housing",
-           "wmt14", "flowers", "movielens"]
+           "wmt14", "wmt16", "flowers", "movielens", "conll05", "sentiment",
+           "voc2012", "mq2007"]

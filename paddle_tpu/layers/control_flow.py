@@ -263,7 +263,10 @@ class ConditionalBlockGuard(BlockGuard):
 
     def __exit__(self, exc_type, exc_val, exc_tb):
         if exc_type is not None:
-            return False
+            # roll back even on error: otherwise the program's current-block
+            # pointer stays inside the abandoned sub-block and later layers
+            # silently land there
+            return super().__exit__(exc_type, exc_val, exc_tb)
         sub_block = self.main_program.current_block()
         res = super().__exit__(exc_type, exc_val, exc_tb)
         self.cond_block.complete(sub_block)

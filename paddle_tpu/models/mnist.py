@@ -1,4 +1,11 @@
-"""MNIST conv net (reference benchmark/fluid/models/mnist.py:35-94)."""
+"""MNIST conv net (reference benchmark/fluid/models/mnist.py:35-94).
+
+Provenance: this module is a BENCHMARK WORKLOAD DEFINITION — the
+layer sequence, filter counts, and depth configs intentionally match
+the reference benchmark model so perf/convergence comparisons are
+apples-to-apples; the implementation is written against this
+framework's own API.
+"""
 
 import paddle_tpu as fluid
 

@@ -6,6 +6,12 @@ basic/bottleneck residuals, stage widths 64/128/256/512). TPU-first notes:
 NCHW API surface is preserved (reference data_format), while conv kernels
 lower to XLA convolutions that the TPU compiler lays out for the MXU;
 batch-norm folds into the conv epilogue under XLA fusion.
+
+Provenance: this module is a BENCHMARK WORKLOAD DEFINITION — the
+layer sequence, filter counts, and depth configs intentionally match
+the reference benchmark model so perf/convergence comparisons are
+apples-to-apples; the implementation is written against this
+framework's own API.
 """
 
 import numpy as np

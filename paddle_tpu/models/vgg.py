@@ -1,4 +1,11 @@
-"""VGG-16 with batch-norm + dropout (reference benchmark/fluid/models/vgg.py:25-104)."""
+"""VGG-16 with batch-norm + dropout (reference benchmark/fluid/models/vgg.py:25-104).
+
+Provenance: this module is a BENCHMARK WORKLOAD DEFINITION — the
+layer sequence, filter counts, and depth configs intentionally match
+the reference benchmark model so perf/convergence comparisons are
+apples-to-apples; the implementation is written against this
+framework's own API.
+"""
 
 import paddle_tpu as fluid
 

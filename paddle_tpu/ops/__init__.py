@@ -16,6 +16,7 @@ from . import loss_ops
 from . import beam_search_ops
 from . import rnn_ops
 from . import control_flow_ops
+from . import concurrency_ops
 from . import io_ops
 from . import metric_ops
 from . import detection_ops

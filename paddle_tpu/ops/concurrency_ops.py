@@ -1,0 +1,114 @@
+"""CSP op kernels: channel_create/send/recv/close, go, select.
+
+Reference parity: operators/{go,channel_send,channel_recv,channel_close,
+select}_op.cc over framework/channel.h:33. All host ops (no_trace): CSP is
+control-plane threading, exactly as the reference runs it, while any math
+inside a Go/Select sub-block executes through the same eager kernels.
+"""
+
+import threading
+import time
+import traceback
+
+from ..core.registry import register_op
+from .util import first, out
+
+
+@register_op("channel_create", no_trace=True, lod_aware=True)
+def channel_create_op(ctx, ins, attrs):
+    from ..concurrency import Channel
+
+    return out(Out=Channel(capacity=int(attrs.get("capacity", 0))))
+
+
+@register_op("channel_send", no_trace=True, lod_aware=True)
+def channel_send_op(ctx, ins, attrs):
+    ch = first(ins, "Channel")
+    ch.send(first(ins, "X"))
+    return out(Status=True)
+
+
+@register_op("channel_recv", no_trace=True, lod_aware=True)
+def channel_recv_op(ctx, ins, attrs):
+    ch = first(ins, "Channel")
+    value, ok = ch.recv()
+    res = {"Status": [ok]}
+    if ok:
+        res["Out"] = [value]
+    return res
+
+
+@register_op("channel_close", no_trace=True, lod_aware=True)
+def channel_close_op(ctx, ins, attrs):
+    first(ins, "Channel").close()
+    return {}
+
+
+@register_op("go", no_trace=True, lod_aware=True)
+def go_op(ctx, ins, attrs):
+    """Run the sub-block on a daemon thread (goroutine). The thread gets a
+    snapshot of the spawning env — channel objects are shared by reference,
+    which is the CSP communication path; plain tensors copy in like the
+    reference's captured inputs."""
+    from ..core import executor_core
+
+    block = attrs["sub_block"]
+    env_snapshot = dict(ctx.env)
+    scope = ctx.scope
+
+    def run():
+        try:
+            thread_ctx = executor_core.OpContext(eager=True, scope=scope)
+            thread_ctx.env = env_snapshot
+            executor_core.run_ops(block.ops, env_snapshot, thread_ctx)
+        except Exception:
+            traceback.print_exc()
+
+    threading.Thread(target=run, daemon=True).start()
+    return {}
+
+
+@register_op("select", no_trace=True, lod_aware=True)
+def select_op(ctx, ins, attrs):
+    """Wait until one case's channel operation can proceed, perform it,
+    then run that case's sub-block (reference select_op.cc)."""
+    from ..core import executor_core
+
+    cases = attrs["cases"]           # [(kind, channel name, value name)]
+    blocks = attrs["case_blocks"]
+    env = ctx.env
+    SEND, RECV, DEFAULT = 0, 1, 2
+
+    def run_case(i, extra=None):
+        if extra:
+            env.update(extra)
+        executor_core.run_ops(blocks[i].ops, env, ctx)
+
+    import queue as _queue
+
+    while True:
+        default_idx = None
+        for i, (kind, ch_name, val_name) in enumerate(cases):
+            if kind == DEFAULT:
+                default_idx = i
+                continue
+            ch = env.get(ch_name)
+            if ch is None:
+                continue
+            if kind == RECV:
+                # non-blocking attempt: a can_recv()-then-recv() pair races
+                # other selects on the same channel (the loser would block
+                # past its default case)
+                try:
+                    value, ok = ch.try_recv()
+                except _queue.Empty:
+                    continue
+                run_case(i, {val_name: value} if ok and val_name else None)
+                return {}
+            if kind == SEND and ch.try_send(env[val_name]):
+                run_case(i)
+                return {}
+        if default_idx is not None:
+            run_case(default_idx)
+            return {}
+        time.sleep(0.001)

@@ -1,0 +1,275 @@
+"""CSP concurrency (channels/Go/Select), new datasets, CLI, k8s generator.
+
+Reference: python/paddle/fluid/tests/test_concurrency.py (channel
+send/recv through Go blocks), notest_concurrency.py, dataset schema tests,
+paddle/scripts/submit_local.sh.in, benchmark/fluid/kube_gen_job.py.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program, program_guard
+
+
+# ---------------------------------------------------------------------------
+# channels / Go / Select
+# ---------------------------------------------------------------------------
+def test_channel_object_semantics():
+    from paddle_tpu.concurrency import Channel
+    import threading
+
+    ch = Channel(capacity=2)
+    ch.send(1)
+    ch.send(2)
+    assert ch.recv() == (1, True)
+    assert ch.recv() == (2, True)
+    ch.close()
+    assert ch.recv() == (None, False)  # closed + drained
+    with pytest.raises(RuntimeError):
+        ch.send(3)
+
+    # rendezvous: send blocks until the receiver arrives
+    ch0 = Channel(capacity=0)
+    got = []
+
+    def receiver():
+        got.append(ch0.recv())
+
+    t = threading.Thread(target=receiver, daemon=True)
+    t.start()
+    ch0.send("hello")
+    t.join(5)
+    assert got == [("hello", True)]
+
+
+def test_go_channel_program_roundtrip():
+    """Go block computes on a thread and hands the result back over a
+    channel (reference test_concurrency.py simple_routine pattern)."""
+    from paddle_tpu import concurrency
+
+    with program_guard(Program(), Program()):
+        ch = concurrency.make_channel(dtype="float32", capacity=1)
+        x = fluid.layers.fill_constant(shape=[2], dtype="float32", value=3.0)
+        with concurrency.Go():
+            doubled = fluid.layers.scale(x, scale=2.0)
+            concurrency.channel_send(ch, doubled)
+        result = fluid.layers.fill_constant(shape=[2], dtype="float32",
+                                            value=0.0)
+        concurrency.channel_recv(ch, result)
+        concurrency.channel_close(ch)
+        exe = fluid.Executor(fluid.CPUPlace())
+        out, = exe.run(fetch_list=[result])
+    np.testing.assert_allclose(np.asarray(out), [6.0, 6.0])
+
+
+def test_select_recv_and_default():
+    from paddle_tpu import concurrency
+
+    with program_guard(Program(), Program()):
+        ch = concurrency.make_channel(dtype="float32", capacity=1)
+        x = fluid.layers.fill_constant(shape=[1], dtype="float32", value=7.0)
+        concurrency.channel_send(ch, x)
+        got = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                         value=-1.0)
+        flag = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                          value=0.0)
+        sel = concurrency.Select()
+        with sel:
+            with sel.case(concurrency.channel_recv, ch, got):
+                fluid.layers.assign(fluid.layers.fill_constant(
+                    shape=[1], dtype="float32", value=1.0), flag)
+            with sel.default():
+                fluid.layers.assign(fluid.layers.fill_constant(
+                    shape=[1], dtype="float32", value=2.0), flag)
+        exe = fluid.Executor(fluid.CPUPlace())
+        g, f = exe.run(fetch_list=[got, flag])
+    np.testing.assert_allclose(np.asarray(g), [7.0])  # recv case fired
+    np.testing.assert_allclose(np.asarray(f), [1.0])
+
+    # empty channel -> default fires
+    with program_guard(Program(), Program()):
+        ch = concurrency.make_channel(dtype="float32", capacity=1)
+        got = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                         value=-1.0)
+        flag = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                          value=0.0)
+        sel = concurrency.Select()
+        with sel:
+            with sel.case(concurrency.channel_recv, ch, got):
+                fluid.layers.assign(fluid.layers.fill_constant(
+                    shape=[1], dtype="float32", value=1.0), flag)
+            with sel.default():
+                fluid.layers.assign(fluid.layers.fill_constant(
+                    shape=[1], dtype="float32", value=2.0), flag)
+        exe = fluid.Executor(fluid.CPUPlace())
+        f, = exe.run(fetch_list=[flag])
+    np.testing.assert_allclose(np.asarray(f), [2.0])
+
+
+def test_close_wakes_parked_sender():
+    """A sender blocked on a rendezvous handshake (or a full buffer) must
+    error out when the channel closes, not leak the thread forever."""
+    from paddle_tpu.concurrency import Channel
+    import threading
+
+    for ch in (Channel(capacity=0), Channel(capacity=1)):
+        if ch.capacity == 1:
+            ch.send("fill")  # second send will block on the full buffer
+        errors = []
+
+        def sender():
+            try:
+                ch.send("parked")
+                if ch.capacity == 0:
+                    errors.append("rendezvous send returned without receiver")
+            except RuntimeError:
+                errors.append("closed")
+
+        t = threading.Thread(target=sender, daemon=True)
+        t.start()
+        import time
+        time.sleep(0.15)
+        ch.close()
+        t.join(5)
+        assert not t.is_alive(), "sender leaked after close"
+        assert errors == ["closed"], errors
+
+
+def test_guard_exception_rolls_back_block():
+    """An exception inside Go()/ConditionalBlock must not leave the
+    program's current-block pointer stuck in the sub-block."""
+    from paddle_tpu import concurrency
+
+    with program_guard(Program(), Program()):
+        prog = fluid.default_main_program()
+        assert prog.current_block().idx == 0
+        with pytest.raises(ValueError):
+            with concurrency.Go():
+                raise ValueError("user error")
+        assert prog.current_block().idx == 0
+        # a layer built now must land in the global block
+        v = fluid.layers.fill_constant(shape=[1], dtype="float32", value=1.0)
+        assert any(v.name in op.output_arg_names()
+                   for op in prog.global_block().ops)
+
+
+# ---------------------------------------------------------------------------
+# datasets
+# ---------------------------------------------------------------------------
+def test_conll05_schema():
+    from paddle_tpu.dataset import conll05
+
+    wd, vd, ld = conll05.get_dict()
+    assert len(wd) == conll05.WORD_DICT_LEN
+    sample = next(conll05.test()())
+    assert len(sample) == 9
+    n = len(sample[0])
+    assert all(len(s) == n for s in sample)
+    assert max(sample[8]) < conll05.LABEL_DICT_LEN
+    assert sum(sample[7]) == 1  # exactly one predicate mark
+    emb = conll05.get_embedding()
+    assert emb.shape == (conll05.WORD_DICT_LEN, 32)
+
+
+def test_sentiment_schema():
+    from paddle_tpu.dataset import sentiment
+
+    d = sentiment.get_word_dict()
+    words, label = next(sentiment.train()())
+    assert label in (0, 1)
+    assert all(0 <= w < len(d) for w in words)
+
+
+def test_wmt16_schema():
+    from paddle_tpu.dataset import wmt16
+
+    d = wmt16.get_dict("en", 100)
+    assert d["<s>"] == 0 and d["<e>"] == 1 and d["<unk>"] == 2
+    src, trg_in, trg_next = next(wmt16.train(1000, 1000)())
+    assert trg_in[0] == 0 and trg_next[-1] == 1
+    assert trg_in[1:] == trg_next[:-1]
+    assert all(3 <= t < 1000 for t in src)
+
+
+def test_voc2012_schema():
+    from paddle_tpu.dataset import voc2012
+
+    img, mask = next(voc2012.train()())
+    assert img.shape == (3, voc2012.H, voc2012.W)
+    assert img.dtype == np.float32
+    assert mask.shape == (voc2012.H, voc2012.W)
+    ids = set(np.unique(mask)) - {255}
+    assert ids and max(ids) < voc2012.NUM_CLASSES
+
+
+def test_mq2007_formats():
+    from paddle_tpu.dataset import mq2007
+
+    f, score = next(mq2007.train(format="pointwise")())
+    assert f.shape == (46,) and score in (0.0, 1.0, 2.0)
+    rel, irr = next(mq2007.train(format="pairwise")())
+    assert rel.shape == irr.shape == (46,)
+    labels, feats = next(mq2007.train(format="listwise")())
+    assert len(labels) == len(feats)
+
+
+# ---------------------------------------------------------------------------
+# CLI + k8s generator
+# ---------------------------------------------------------------------------
+def test_cli_version_and_flags():
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "version"],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": "/root/repo"})
+    assert out.returncode == 0
+    assert "paddle_tpu" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "flags"],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": "/root/repo"})
+    assert out.returncode == 0
+    assert "FLAGS_check_nan_inf" in out.stdout
+
+
+def test_kube_gen_job(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "tools/kube_gen_job.py", "--name", "mnist",
+         "--image", "example/image:1", "--trainers", "4",
+         "--pservers", "2", "--entry", "train.py",
+         "--outdir", str(tmp_path)],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr
+    trainer = json.load(open(tmp_path / "trainer.json"))
+    assert trainer["kind"] == "Job"
+    assert trainer["spec"]["parallelism"] == 4
+    env = {e["name"]: e.get("value")
+           for e in trainer["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["PADDLE_TRAINERS"] == "4"
+    assert "mnist-pserver-0" in env["PADDLE_PSERVERS"]
+    ps = json.load(open(tmp_path / "pserver.json"))
+    assert ps["kind"] == "StatefulSet" and ps["spec"]["replicas"] == 2
+    svc = json.load(open(tmp_path / "pserver-service.json"))
+    assert svc["spec"]["clusterIP"] == "None"
+    # trainer id comes from the Indexed-Job env var, never the pod name
+    cmd = trainer["spec"]["template"]["spec"]["containers"][0]["command"][2]
+    assert "$JOB_COMPLETION_INDEX" in cmd and "sed" not in cmd
+
+    # trainer-only (collective) deployment: no empty --pservers flag that
+    # would swallow the entry script
+    out = subprocess.run(
+        [sys.executable, "tools/kube_gen_job.py", "--name", "dp",
+         "--image", "example/image:1", "--trainers", "2",
+         "--outdir", str(tmp_path / "dp")],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr
+    dp_trainer = json.load(open(tmp_path / "dp" / "trainer.json"))
+    cmd = dp_trainer["spec"]["template"]["spec"]["containers"][0]["command"][2]
+    assert "--pservers" not in cmd
+    assert cmd.rstrip().endswith("train.py")

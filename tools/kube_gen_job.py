@@ -1,0 +1,159 @@
+"""Generate Kubernetes job manifests for a distributed training job.
+
+Reference parity: benchmark/fluid/kube_gen_job.py:1 — emits a pserver
+ReplicaSet + trainer Job wired together through the PADDLE_* environment
+variables that the Trainer's cluster bootstrap (trainer.py) and the
+`python -m paddle_tpu train` CLI read.
+
+Manifests are written as JSON, which every Kubernetes API/kubectl accepts
+(JSON is a YAML subset — no yaml dependency needed in this environment).
+
+Usage:
+  python tools/kube_gen_job.py --name mnist --image my/image:tag \
+      --trainers 4 --pservers 2 --entry "python train.py" --outdir ./k8s
+"""
+
+import argparse
+import json
+import os
+
+
+def _env(name, value, field_path=None):
+    if field_path:
+        return {"name": name,
+                "valueFrom": {"fieldRef": {"fieldPath": field_path}}}
+    return {"name": name, "value": str(value)}
+
+
+def pserver_manifest(args):
+    """ReplicaSet of pservers; each serves on PSERVER_PORT and discovers its
+    peers through the headless service DNS (reference kube_gen_job.py
+    pserver ReplicaSet)."""
+    endpoints = ",".join(
+        f"{args.name}-pserver-{i}.{args.name}-pserver:{args.port}"
+        for i in range(args.pservers))
+    container = {
+        "name": "pserver",
+        "image": args.image,
+        "command": ["/bin/sh", "-c",
+                    f"python -m paddle_tpu train --role pserver "
+                    f"--trainers {args.trainers} "
+                    f"--pservers {endpoints} "
+                    f"--current-endpoint $(POD_NAME).{args.name}-pserver:"
+                    f"{args.port} {args.entry_script}"],
+        "env": [
+            _env("POD_NAME", None, field_path="metadata.name"),
+            _env("PADDLE_TRAINING_ROLE", "PSERVER"),
+            _env("PADDLE_TRAINERS", args.trainers),
+            _env("PADDLE_PSERVERS", endpoints),
+        ],
+        "ports": [{"containerPort": args.port}],
+        "resources": {"requests": {"cpu": args.pserver_cpu,
+                                   "memory": args.pserver_mem}},
+    }
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {"name": f"{args.name}-pserver"},
+        "spec": {
+            "serviceName": f"{args.name}-pserver",
+            "replicas": args.pservers,
+            "selector": {"matchLabels": {"app": f"{args.name}-pserver"}},
+            "template": {
+                "metadata": {"labels": {"app": f"{args.name}-pserver"}},
+                "spec": {"containers": [container]},
+            },
+        },
+    }
+
+
+def pserver_service(args):
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": f"{args.name}-pserver"},
+        "spec": {
+            "clusterIP": "None",  # headless: stable per-pod DNS
+            "selector": {"app": f"{args.name}-pserver"},
+            "ports": [{"port": args.port}],
+        },
+    }
+
+
+def trainer_manifest(args):
+    endpoints = ",".join(
+        f"{args.name}-pserver-{i}.{args.name}-pserver:{args.port}"
+        for i in range(args.pservers))
+    # Indexed Jobs inject JOB_COMPLETION_INDEX (pod names carry a random
+    # suffix, so parsing the name would yield garbage)
+    pserver_flag = f"--pservers {endpoints} " if endpoints else ""
+    container = {
+        "name": "trainer",
+        "image": args.image,
+        "command": ["/bin/sh", "-c",
+                    f"python -m paddle_tpu train --role trainer "
+                    f"--trainers {args.trainers} "
+                    f"--trainer-id $JOB_COMPLETION_INDEX "
+                    f"{pserver_flag}{args.entry_script}"],
+        "env": [
+            _env("POD_NAME", None, field_path="metadata.name"),
+            _env("PADDLE_TRAINING_ROLE", "TRAINER"),
+            _env("PADDLE_TRAINERS", args.trainers),
+            _env("PADDLE_PSERVERS", endpoints),
+        ],
+        "resources": {"requests": {"cpu": args.trainer_cpu,
+                                   "memory": args.trainer_mem},
+                      "limits": {args.accelerator_key: args.accelerators}
+                      if args.accelerators else {}},
+    }
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": f"{args.name}-trainer"},
+        "spec": {
+            "completions": args.trainers,
+            "parallelism": args.trainers,
+            "completionMode": "Indexed",
+            "template": {
+                "metadata": {"labels": {"app": f"{args.name}-trainer"}},
+                "spec": {"containers": [container],
+                         "restartPolicy": "Never"},
+            },
+        },
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--name", required=True)
+    p.add_argument("--image", required=True)
+    p.add_argument("--entry", dest="entry_script", default="train.py")
+    p.add_argument("--trainers", type=int, default=1)
+    p.add_argument("--pservers", type=int, default=0)
+    p.add_argument("--port", type=int, default=6174)
+    p.add_argument("--trainer-cpu", default="4")
+    p.add_argument("--trainer-mem", default="8Gi")
+    p.add_argument("--pserver-cpu", default="2")
+    p.add_argument("--pserver-mem", default="4Gi")
+    p.add_argument("--accelerators", type=int, default=0)
+    p.add_argument("--accelerator-key", default="google.com/tpu")
+    p.add_argument("--outdir", default=".")
+    args = p.parse_args(argv)
+
+    os.makedirs(args.outdir, exist_ok=True)
+    written = []
+    manifests = [("trainer.json", trainer_manifest(args))]
+    if args.pservers:
+        manifests += [("pserver.json", pserver_manifest(args)),
+                      ("pserver-service.json", pserver_service(args))]
+    for fname, manifest in manifests:
+        path = os.path.join(args.outdir, fname)
+        with open(path, "w") as f:
+            json.dump(manifest, f, indent=2)
+        written.append(path)
+    print("\n".join(written))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
