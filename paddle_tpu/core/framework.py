@@ -98,6 +98,9 @@ class Variable:
         self.type = type
         self.initializer = initializer
         self.error_clip = kwargs.get("error_clip", None)
+        # user-declared mesh placement (parallel.set_sharding): a tuple of
+        # mesh-axis names / None per dim, honored by ParallelExecutor
+        self.sharding = kwargs.get("sharding", None)
 
     @property
     def grad_name(self):

@@ -909,13 +909,24 @@ def reshape(x, shape, actual_shape=None, act=None, inplace=True, name=None):
     known = None
     if x.shape is not None and -1 not in shape and 0 not in shape:
         known = tuple(shape)
-    elif x.shape is not None and None not in x.shape:
-        total = int(math.prod([s for s in x.shape]))
+    elif x.shape is not None:
+        for i, s in enumerate(shape):
+            if s == 0 and i >= len(x.shape):
+                raise ValueError(
+                    f"reshape: 0 at position {i} has no input dim to copy "
+                    f"(input rank {len(x.shape)})")
         spec = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
         neg = [i for i, s in enumerate(spec) if s == -1]
-        if len(neg) == 1:
+        if len(neg) == 1 and None not in x.shape and -1 not in x.shape:
+            # fully-static input: resolve the single -1 exactly
+            total = int(math.prod([s for s in x.shape]))
             rest = int(math.prod([s for s in spec if s != -1]))
             spec[neg[0]] = total // rest if rest else -1
+            known = tuple(spec)
+        elif neg == [0]:
+            # dynamic input: only a LEADING -1 may stay (the house batch
+            # sentinel every shape consumer understands); a non-batch -1
+            # left unresolved would leak into fc's size products
             known = tuple(spec)
     out = helper.create_tmp_variable(dtype=x.dtype, shape=known)
     resolved = list(known) if known is not None else list(shape)

@@ -1,15 +1,21 @@
 """Image/detection ops.
 
-Reference parity: operators/{roi_pool,box_coder,iou_similarity,prior_box,
-multiclass_nms(detection/),bipartite_match,mine_hard_examples,ssd_loss}.
-Round-1 coverage: roi_pool + box utilities; the SSD loss pipeline is staged
-for a later round (tracked in ROADMAP.md).
+Reference parity: operators/detection/{prior_box,bipartite_match,
+target_assign,mine_hard_examples,multiclass_nms,box_coder,iou_similarity}
+_op.cc + operators/roi_pool_op.cc.
+
+TPU mapping: prior_box / box_coder / iou_similarity are static-shape jnp
+(traced, MXU/VPU friendly). The matching/mining/NMS family is inherently
+data-dependent (greedy loops, dynamic detection counts) and runs as host
+ops — exactly where the reference runs them (CPU-only kernels).
 """
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from ..core.registry import register_op, set_stop_gradient_outputs
+from ..core.registry import register_op, set_stop_gradient_outputs, SeqTensor
 from .util import first, out
 
 
@@ -90,6 +96,22 @@ def box_coder_op(ctx, ins, attrs):
     pcx = prior[:, 0] + 0.5 * pw
     pcy = prior[:, 1] + 0.5 * ph
     var = prior_var if prior_var is not None else jnp.ones_like(prior)
+    if attrs.get("elementwise", False) and code_type.startswith("encode"):
+        # target [..., M, 4] paired 1:1 with the M priors (SSD loc targets)
+        tw = target[..., 2] - target[..., 0]
+        th = target[..., 3] - target[..., 1]
+        tcx = target[..., 0] + 0.5 * tw
+        tcy = target[..., 1] + 0.5 * th
+        o = jnp.stack(
+            [
+                (tcx - pcx) / pw / var[:, 0],
+                (tcy - pcy) / ph / var[:, 1],
+                jnp.log(jnp.maximum(tw / pw, 1e-10)) / var[:, 2],
+                jnp.log(jnp.maximum(th / ph, 1e-10)) / var[:, 3],
+            ],
+            axis=-1,
+        )
+        return out(OutputBox=o)
     if code_type.startswith("encode"):
         tw = target[:, 2] - target[:, 0]
         th = target[:, 3] - target[:, 1]
@@ -112,3 +134,283 @@ def box_coder_op(ctx, ins, attrs):
         oh = jnp.exp(t[..., 3] * var[:, 3]) * ph
         o = jnp.stack([ocx - 0.5 * ow, ocy - 0.5 * oh, ocx + 0.5 * ow, ocy + 0.5 * oh], axis=-1)
     return out(OutputBox=o)
+
+
+# ---------------------------------------------------------------------------
+# SSD family
+# ---------------------------------------------------------------------------
+def _expand_aspect_ratios(ratios, flip):
+    """reference prior_box_op.h ExpandAspectRatios:25."""
+    outp = [1.0]
+    for ar in ratios:
+        if any(abs(ar - e) < 1e-6 for e in outp):
+            continue
+        outp.append(float(ar))
+        if flip:
+            outp.append(1.0 / float(ar))
+    return outp
+
+
+@register_op("prior_box")
+def prior_box_op(ctx, ins, attrs):
+    """reference operators/detection/prior_box_op.h:56 — SSD anchor grid.
+    Boxes/Variances: [H, W, num_priors, 4], normalized to the image size."""
+    feat = first(ins, "Input")    # [N, C, H, W]
+    image = first(ins, "Image")   # [N, C, IH, IW]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = _expand_aspect_ratios(attrs.get("aspect_ratios", [1.0]),
+                                attrs.get("flip", False))
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    offset = attrs.get("offset", 0.5)
+    H, W = int(feat.shape[2]), int(feat.shape[3])
+    IH, IW = int(image.shape[2]), int(image.shape[3])
+    step_w = attrs.get("step_w", 0.0) or IW / W
+    step_h = attrs.get("step_h", 0.0) or IH / H
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w   # [W]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h   # [H]
+
+    # per-position prior sizes, reference emission order: for each min_size,
+    # all aspect ratios, then that min_size's square sqrt(min*max) prior
+    half_wh = []
+    for s, ms in enumerate(min_sizes):
+        for ar in ars:
+            half_wh.append((ms * np.sqrt(ar) / 2.0, ms / np.sqrt(ar) / 2.0))
+        if max_sizes:
+            side = np.sqrt(ms * max_sizes[s]) / 2.0
+            half_wh.append((side, side))
+    half = jnp.asarray(half_wh, jnp.float32)                    # [P, 2]
+    P = half.shape[0]
+
+    cxg = jnp.broadcast_to(cx[None, :, None], (H, W, P))
+    cyg = jnp.broadcast_to(cy[:, None, None], (H, W, P))
+    hw = jnp.broadcast_to(half[None, None, :, 0], (H, W, P))
+    hh = jnp.broadcast_to(half[None, None, :, 1], (H, W, P))
+    boxes = jnp.stack(
+        [(cxg - hw) / IW, (cyg - hh) / IH, (cxg + hw) / IW, (cyg + hh) / IH],
+        axis=-1)
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    vars_ = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (H, W, P, 4))
+    return out(Boxes=boxes, Variances=vars_)
+
+
+set_stop_gradient_outputs("prior_box", ["Boxes", "Variances"])
+
+
+def _bipartite_match_one(dist):
+    """reference bipartite_match_op.cc:59 — greedy global-max matching.
+    dist: [rows(gt), cols(priors)] -> (col_to_row [C], col_dist [C]).
+    Vectorized: G rounds of an O(G*P) masked argmax (a python triple loop
+    would dominate host time at SSD scale, P ~ 8k per image per step)."""
+    rows, cols = dist.shape
+    match = np.full(cols, -1, np.int64)
+    mdist = np.zeros(cols, np.float32)
+    d = np.where(dist >= 1e-6, dist.astype(np.float32), -1.0)
+    row_free = np.ones(rows, bool)
+    col_free = np.ones(cols, bool)
+    for _ in range(min(rows, cols)):
+        sub = np.where(row_free[:, None] & col_free[None, :], d, -1.0)
+        flat = int(np.argmax(sub))
+        m, j = divmod(flat, cols)
+        if sub[m, j] < 0:
+            break
+        match[j] = m
+        mdist[j] = dist[m, j]
+        row_free[m] = False
+        col_free[j] = False
+    return match, mdist
+
+
+@register_op("bipartite_match", no_trace=True, lod_aware=True)
+def bipartite_match_op(ctx, ins, attrs):
+    """DistMat: SeqTensor [sum_gt, P] (rows per image) or dense [G, P].
+    -> ColToRowMatchIndices [B, P] (gt row per prior, -1 unmatched, LOCAL
+    to the image), ColToRowMatchDist [B, P]."""
+    dist = first(ins, "DistMat")
+    match_type = attrs.get("match_type", "bipartite")
+    thresh = float(attrs.get("dist_threshold", 0.5))
+    if isinstance(dist, SeqTensor):
+        data = np.asarray(dist.data)
+        lengths = np.asarray(dist.lengths)
+    else:
+        data = np.asarray(dist)
+        lengths = np.asarray([data.shape[0]])
+    P = data.shape[1]
+    B = len(lengths)
+    match = np.full((B, P), -1, np.int64)
+    mdist = np.zeros((B, P), np.float32)
+    off = 0
+    for b, L in enumerate(lengths):
+        sub = data[off:off + L]
+        if L > 0:
+            m, d = _bipartite_match_one(sub)
+            if match_type == "per_prediction":
+                # reference ArgMaxMatch: unmatched priors take their argmax
+                # gt when overlap > threshold
+                am = sub.argmax(axis=0)
+                amd = sub.max(axis=0)
+                extra = (m == -1) & (amd > thresh)
+                m[extra] = am[extra]
+                d[extra] = amd[extra]
+            match[b], mdist[b] = m, d
+        off += L
+    return out(ColToRowMatchIndices=match, ColToRowMatchDist=mdist)
+
+
+@register_op("target_assign", no_trace=True, lod_aware=True)
+def target_assign_op(ctx, ins, attrs):
+    """reference operators/detection/target_assign_op.cc: gather each
+    prior's matched gt row from the per-image X slice; unmatched priors get
+    mismatch_value and weight 0. NegIndices (hard negatives, per image)
+    additionally get weight 1 with the mismatch value (their target is the
+    background class)."""
+    x = first(ins, "X")                 # SeqTensor [sum_gt, D] or [G, D]
+    match = np.asarray(first(ins, "MatchIndices"))   # [B, P]
+    neg = first(ins, "NegIndices")
+    mismatch = attrs.get("mismatch_value", 0)
+    if isinstance(x, SeqTensor):
+        data = np.asarray(x.data)
+        lengths = np.asarray(x.lengths)
+    else:
+        data = np.asarray(x)
+        lengths = np.asarray([data.shape[0]])
+    data = data.reshape(data.shape[0], -1)
+    B, P = match.shape
+    D = data.shape[1]
+    outv = np.full((B, P, D), mismatch, data.dtype)
+    w = np.zeros((B, P, 1), np.float32)
+    off = 0
+    for b in range(B):
+        L = int(lengths[b]) if b < len(lengths) else 0
+        for p in range(P):
+            m = match[b, p]
+            if m >= 0:
+                outv[b, p] = data[off + m]
+                w[b, p] = 1.0
+        off += L
+    if neg is not None:
+        nrows = np.asarray(neg.data).reshape(-1)
+        nlens = np.asarray(neg.lengths)
+        off = 0
+        for b in range(B):
+            for i in nrows[off:off + int(nlens[b])]:
+                w[b, int(i)] = 1.0
+            off += int(nlens[b])
+    return out(Out=outv, OutWeight=w)
+
+
+@register_op("mine_hard_examples", no_trace=True, lod_aware=True)
+def mine_hard_examples_op(ctx, ins, attrs):
+    """reference operators/detection/mine_hard_examples_op.cc
+    (max_negative): pick the highest-loss negatives up to
+    neg_pos_ratio * num_pos per image; negatives with MatchDist above
+    neg_dist_threshold are excluded. -> NegIndices (SeqTensor [sum_neg, 1])
+    + UpdatedMatchIndices (unchanged positives, -1 elsewhere)."""
+    mining_type = attrs.get("mining_type", "max_negative")
+    if mining_type != "max_negative":
+        # same restriction as the reference composite ("now only support
+        # max_negative", detection.py:425) — fail loudly, don't silently
+        # substitute a different mining policy
+        raise NotImplementedError(
+            f"mine_hard_examples: mining_type={mining_type!r} unsupported "
+            f"(only 'max_negative')")
+    cls_loss = np.asarray(first(ins, "ClsLoss")).reshape(
+        np.asarray(first(ins, "MatchIndices")).shape)
+    match = np.asarray(first(ins, "MatchIndices"))
+    mdist = first(ins, "MatchDist")
+    mdist = np.asarray(mdist) if mdist is not None else None
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_thresh = float(attrs.get("neg_dist_threshold", 0.5))
+    B, P = match.shape
+    neg_rows = []
+    lengths = []
+    for b in range(B):
+        pos = match[b] >= 0
+        num_neg = int(min(P - pos.sum(), np.ceil(ratio * pos.sum())))
+        cand = np.where(~pos if mdist is None
+                        else (~pos) & (mdist[b] < neg_thresh))[0]
+        order = cand[np.argsort(-cls_loss[b, cand], kind="stable")]
+        chosen = np.sort(order[:num_neg])
+        neg_rows.extend(chosen.tolist())
+        lengths.append(len(chosen))
+    neg = SeqTensor(
+        jnp.asarray(np.asarray(neg_rows, np.int64).reshape(-1, 1)),
+        jnp.asarray(lengths, jnp.int32))
+    return out(NegIndices=neg, UpdatedMatchIndices=match)
+
+
+def _nms_one_class(boxes, scores, score_threshold, nms_threshold, top_k,
+                   eta):
+    """reference multiclass_nms_op.cc NMSFast:134."""
+    idx = np.where(scores > score_threshold)[0]
+    idx = idx[np.argsort(-scores[idx], kind="stable")]
+    if top_k > -1:
+        idx = idx[:top_k]
+    selected = []
+    adaptive = nms_threshold
+    for i in idx:
+        keep = True
+        for j in selected:
+            # normalized-box IoU with +1e-10 guards
+            ix1 = max(boxes[i, 0], boxes[j, 0])
+            iy1 = max(boxes[i, 1], boxes[j, 1])
+            ix2 = min(boxes[i, 2], boxes[j, 2])
+            iy2 = min(boxes[i, 3], boxes[j, 3])
+            iw = max(ix2 - ix1, 0.0)
+            ih = max(iy2 - iy1, 0.0)
+            inter = iw * ih
+            a = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            bA = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            ov = inter / max(a + bA - inter, 1e-10)
+            if ov > adaptive:
+                keep = False
+                break
+        if keep:
+            selected.append(int(i))
+            if eta < 1.0 and adaptive > 0.5:
+                adaptive *= eta
+    return selected
+
+
+@register_op("multiclass_nms", no_trace=True, lod_aware=True)
+def multiclass_nms_op(ctx, ins, attrs):
+    """reference operators/detection/multiclass_nms_op.cc: per-class NMS +
+    global keep_top_k. Scores [N, C, M], BBoxes [N, M, 4] ->
+    Out SeqTensor [total_det, 6] rows (label, score, x1, y1, x2, y2);
+    an image with no detections contributes one (-1, ...) row like the
+    reference's special case."""
+    boxes = np.asarray(first(ins, "BBoxes"))
+    scores = np.asarray(first(ins, "Scores"))
+    bg = int(attrs.get("background_label", 0))
+    score_th = float(attrs.get("score_threshold", 0.0))
+    nms_th = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    keep_top_k = int(attrs.get("keep_top_k", -1))
+    eta = float(attrs.get("nms_eta", 1.0))
+    N, C, M = scores.shape
+    rows = []
+    lengths = []
+    for n in range(N):
+        dets = []
+        for c in range(C):
+            if c == bg:
+                continue
+            for i in _nms_one_class(boxes[n], scores[n, c], score_th,
+                                    nms_th, nms_top_k, eta):
+                dets.append((float(scores[n, c, i]), c, i))
+        if keep_top_k > -1 and len(dets) > keep_top_k:
+            dets.sort(key=lambda t: -t[0])
+            dets = dets[:keep_top_k]
+        if not dets:
+            rows.append([-1.0, 0.0, 0.0, 0.0, 0.0, 0.0])
+            lengths.append(1)
+            continue
+        for s, c, i in dets:
+            rows.append([float(c), s] + boxes[n, i].tolist())
+        lengths.append(len(dets))
+    return out(Out=SeqTensor(
+        jnp.asarray(np.asarray(rows, np.float32)),
+        jnp.asarray(lengths, jnp.int32)))

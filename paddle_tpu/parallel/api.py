@@ -1,0 +1,39 @@
+"""User-facing sharding rules: declare how a Program variable is laid out
+over the device mesh.
+
+Reference contrast: the reference has no per-parameter placement API — its
+tensor-parallel story is the pserver block-splitting transpiler. On TPU the
+idiomatic form is a NamedSharding per parameter: annotate variables with
+mesh-axis names and ParallelExecutor places state accordingly, letting XLA
+insert the tensor-parallel collectives (SURVEY §2.4 TP row).
+
+    w = fluid.layers.create_parameter(...)
+    fluid.parallel.set_sharding(w, (None, "mp"))   # shard columns over mp
+    pe = fluid.ParallelExecutor(loss_name=..., mesh_shape={"dp": 2, "mp": 4})
+"""
+
+from ..core.framework import Variable
+
+__all__ = ["set_sharding", "get_sharding"]
+
+
+def set_sharding(var, spec):
+    """Declare `var`'s mesh placement. spec: one entry per tensor dim —
+    a mesh axis name (str) to shard that dim, or None to replicate it.
+    A spec shorter than the rank leaves trailing dims replicated."""
+    if not isinstance(var, Variable):
+        raise TypeError(f"set_sharding expects a Variable, got {type(var)}")
+    spec = tuple(spec)
+    for e in spec:
+        if e is not None and not isinstance(e, str):
+            raise TypeError(f"spec entries must be mesh-axis names or None, "
+                            f"got {e!r}")
+    if var.shape is not None and len(spec) > len(var.shape):
+        raise ValueError(
+            f"spec {spec} longer than {var.name}'s rank {len(var.shape)}")
+    var.sharding = spec
+    return var
+
+
+def get_sharding(var):
+    return getattr(var, "sharding", None)

@@ -74,6 +74,7 @@ class ParallelExecutor:
         num_trainers=1,
         trainer_id=0,
         use_tpu=None,
+        mesh_shape=None,
         **kwargs,
     ):
         self._program = main_program or default_main_program()
@@ -90,7 +91,20 @@ class ParallelExecutor:
         else:
             accel_devs = devs
         self._devices = accel_devs
-        self._mesh = Mesh(np.array(self._devices), ("dp",))
+        if mesh_shape:
+            # user-declared multi-axis mesh ({"dp": 2, "mp": 4}); variables
+            # annotated via parallel.set_sharding place onto these axes
+            axes = list(mesh_shape.items())
+            total = int(np.prod([s for _, s in axes]))
+            if total != len(self._devices):
+                raise ValueError(
+                    f"mesh_shape {mesh_shape} needs {total} devices, have "
+                    f"{len(self._devices)}")
+            self._mesh = Mesh(
+                np.array(self._devices).reshape([s for _, s in axes]),
+                tuple(n for n, _ in axes))
+        else:
+            self._mesh = Mesh(np.array(self._devices), ("dp",))
         self._compile_cache = {}
         self._step = 0
         self.num_trainers = num_trainers
@@ -102,8 +116,30 @@ class ParallelExecutor:
 
     # ------------------------------------------------------------------
     def _state_sharding(self, name, value):
-        """Replicated by default; BuildStrategy.Reduce shards optimizer
-        accumulators (non-Parameter persistables) on dim 0 when divisible."""
+        """User set_sharding() rules win; else replicated by default, with
+        BuildStrategy.Reduce sharding optimizer accumulators (non-Parameter
+        persistables) on dim 0 when divisible (ZeRO-1 analogue)."""
+        var = self._program.global_block().vars.get(name)
+        spec = getattr(var, "sharding", None) if var is not None else None
+        if spec is not None:
+            ndim = len(value.shape) if hasattr(value, "shape") else 0
+            if len(spec) > ndim:
+                raise ValueError(
+                    f"{name}: sharding spec {spec} longer than the runtime "
+                    f"rank {ndim}")
+            for d, ax in enumerate(spec):
+                if ax is None:
+                    continue
+                if ax not in self._mesh.shape:
+                    raise ValueError(
+                        f"{name}: sharding axis {ax!r} not in the mesh "
+                        f"{dict(self._mesh.shape)} — pass mesh_shape= to "
+                        f"ParallelExecutor")
+                if value.shape[d] % self._mesh.shape[ax] != 0:
+                    raise ValueError(
+                        f"{name} dim {d} ({value.shape[d]}) not divisible "
+                        f"by mesh axis {ax!r} ({self._mesh.shape[ax]})")
+            return NamedSharding(self._mesh, P(*spec))
         n = len(self._devices)
         if (
             self._build_strategy.reduce_strategy == BuildStrategy.ReduceStrategy.Reduce
@@ -167,8 +203,20 @@ class ParallelExecutor:
             v = scope.find_var(n)
             if isinstance(v, LoDTensor):
                 v = executor_core.feed_to_tracevalue(v)
-            if not hasattr(v, "sharding") or v.sharding is None or not getattr(v, "committed", True):
-                v = jax.device_put(jax.numpy.asarray(v), self._state_sharding(n, np.asarray(v)))
+            var = program.global_block().vars.get(n)
+            annotated = getattr(var, "sharding", None) is not None
+            if annotated:
+                # the rule must win over whatever placement startup left
+                # behind — but once the array already carries the desired
+                # NamedSharding (every step after the first), re-placing
+                # would all-gather the shards to host each run
+                desired = self._state_sharding(n, v)
+                if getattr(v, "sharding", None) != desired:
+                    v = jax.device_put(jax.numpy.asarray(v), desired)
+            elif not hasattr(v, "sharding") or v.sharding is None \
+                    or not getattr(v, "committed", True):
+                v = jax.device_put(jax.numpy.asarray(v),
+                                   self._state_sharding(n, v))
             (mut_state if n in out_set else const_state)[n] = v
 
         rng = jax.random.fold_in(jax.random.PRNGKey(program.random_seed), self._step)

@@ -1,0 +1,229 @@
+"""SSD detection pipeline: prior_box, bipartite matching, target
+assignment, hard-negative mining, multiclass NMS, ssd_loss training, and
+detection_output inference.
+
+Reference: unittests/test_prior_box_op.py, test_bipartite_match_op.py,
+test_target_assign_op.py, test_mine_hard_examples_op.py,
+test_multiclass_nms_op.py, test_ssd_loss.py, test_detection_output_op.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program, program_guard
+from paddle_tpu.core import executor_core, registry
+
+
+def run_op(op_type):
+    d = registry.lookup(op_type)
+    return lambda ctx, ins, attrs: registry.run_kernel(d, ctx, ins, attrs)
+
+
+def _ctx():
+    return executor_core.OpContext(eager=True)
+
+
+def test_prior_box_matches_reference_formula():
+    import jax.numpy as jnp
+
+    feat = jnp.zeros((1, 8, 2, 3))    # H=2, W=3
+    image = jnp.zeros((1, 3, 40, 60))  # IH=40, IW=60
+    res = run_op("prior_box")(
+        _ctx(), {"Input": [feat], "Image": [image]},
+        {"min_sizes": [10.0], "max_sizes": [20.0],
+         "aspect_ratios": [2.0], "flip": True, "clip": True,
+         "variances": [0.1, 0.1, 0.2, 0.2], "step_w": 0.0, "step_h": 0.0,
+         "offset": 0.5})
+    boxes = np.asarray(res["Boxes"][0])
+    vars_ = np.asarray(res["Variances"][0])
+    # priors per position: ar {1, 2, 1/2} + sqrt(min*max) square = 4
+    assert boxes.shape == (2, 3, 4, 4)
+    assert vars_.shape == (2, 3, 4, 4)
+    # position (h=0, w=0): center = (0.5*20, 0.5*20) = (10, 10)
+    # ar=1 prior: half = 5 -> (5/60, 5/40, 15/60, 15/40)
+    np.testing.assert_allclose(
+        boxes[0, 0, 0], [5 / 60, 5 / 40, 15 / 60, 15 / 40], rtol=1e-5)
+    # square prior half = sqrt(200)/2
+    s = np.sqrt(10 * 20.0) / 2
+    np.testing.assert_allclose(
+        boxes[0, 0, 3], [(10 - s) / 60, (10 - s) / 40,
+                         (10 + s) / 60, (10 + s) / 40], rtol=1e-5)
+    np.testing.assert_allclose(vars_[1, 2, 1], [0.1, 0.1, 0.2, 0.2])
+    assert boxes.min() >= 0.0 and boxes.max() <= 1.0  # clip
+
+
+def test_bipartite_match_greedy_semantics():
+    # global max first: gt1-p0 (0.9) wins, then gt0 takes its best free col
+    dist = np.asarray([[0.8, 0.3, 0.2],
+                       [0.9, 0.6, 0.1]], np.float32)
+    res = run_op("bipartite_match")(
+        _ctx(), {"DistMat": [dist]}, {"match_type": "bipartite"})
+    m = np.asarray(res["ColToRowMatchIndices"][0])[0]
+    d = np.asarray(res["ColToRowMatchDist"][0])[0]
+    np.testing.assert_array_equal(m, [1, 0, -1])
+    np.testing.assert_allclose(d, [0.9, 0.3, 0.0], rtol=1e-6)
+
+    # per_prediction: unmatched cols above threshold take their argmax row
+    res = run_op("bipartite_match")(
+        _ctx(), {"DistMat": [dist]},
+        {"match_type": "per_prediction", "dist_threshold": 0.15})
+    m = np.asarray(res["ColToRowMatchIndices"][0])[0]
+    np.testing.assert_array_equal(m, [1, 0, 0])  # col2 argmax row 0 (0.2)
+
+
+def test_target_assign_with_negatives():
+    from paddle_tpu.core.registry import SeqTensor
+    import jax.numpy as jnp
+
+    # 2 images: 2 gt rows then 1 gt row
+    x = SeqTensor(jnp.asarray([[1.0], [2.0], [5.0]]),
+                  jnp.asarray([2, 1], jnp.int32))
+    match = np.asarray([[0, -1, 1], [-1, 0, -1]], np.int64)
+    neg = SeqTensor(jnp.asarray([[1]], jnp.int64),
+                    jnp.asarray([1, 0], jnp.int32))
+    res = run_op("target_assign")(
+        _ctx(), {"X": [x], "MatchIndices": [match], "NegIndices": [neg]},
+        {"mismatch_value": 9})
+    o = np.asarray(res["Out"][0]).reshape(2, 3)
+    w = np.asarray(res["OutWeight"][0]).reshape(2, 3)
+    np.testing.assert_allclose(o, [[1, 9, 2], [9, 5, 9]])
+    # weights: positives 1; image0 prior1 is a mined negative -> weight 1
+    np.testing.assert_allclose(w, [[1, 1, 1], [0, 1, 0]])
+
+
+def test_mine_hard_examples_max_negative():
+    cls_loss = np.asarray([[0.1, 0.9, 0.5, 0.7]], np.float32)
+    match = np.asarray([[0, -1, -1, -1]], np.int64)
+    mdist = np.asarray([[0.8, 0.1, 0.2, 0.6]], np.float32)
+    res = run_op("mine_hard_examples")(
+        _ctx(), {"ClsLoss": [cls_loss], "MatchIndices": [match],
+                 "MatchDist": [mdist]},
+        {"neg_pos_ratio": 2.0, "neg_dist_threshold": 0.5})
+    neg = res["NegIndices"][0]
+    rows = np.asarray(neg.data).reshape(-1)
+    # 1 positive -> up to 2 negatives; prior3 excluded (dist 0.6 > 0.5);
+    # highest-loss eligible negatives: prior1 (0.9), prior2 (0.5)
+    np.testing.assert_array_equal(np.sort(rows), [1, 2])
+
+
+def test_multiclass_nms():
+    boxes = np.asarray([[[0, 0, 1, 1],
+                         [0, 0, 1.05, 1.05],   # near-duplicate of box 0
+                         [2, 2, 3, 3]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]  # class 1 (class 0 = background)
+    res = run_op("multiclass_nms")(
+        _ctx(), {"BBoxes": [boxes], "Scores": [scores]},
+        {"background_label": 0, "score_threshold": 0.1,
+         "nms_threshold": 0.5, "nms_top_k": -1, "keep_top_k": -1})
+    det = res["Out"][0]
+    rows = np.asarray(det.data)
+    # duplicate suppressed: 2 detections (box0 @0.9, box2 @0.7)
+    assert rows.shape[0] == 2
+    np.testing.assert_allclose(rows[:, 1], [0.9, 0.7], rtol=1e-6)
+    np.testing.assert_allclose(rows[0, 2:], [0, 0, 1, 1])
+
+    # empty image -> the reference's single (-1, ...) placeholder row
+    res = run_op("multiclass_nms")(
+        _ctx(), {"BBoxes": [boxes], "Scores": [np.zeros((1, 2, 3),
+                                                        np.float32)]},
+        {"background_label": 0, "score_threshold": 0.1,
+         "nms_threshold": 0.5})
+    rows = np.asarray(res["Out"][0].data)
+    assert rows.shape[0] == 1 and rows[0, 0] == -1.0
+
+
+def _ssd_program(P=8, C=3):
+    img_feat = fluid.layers.data(name="feat", shape=[P * 4],
+                                 dtype="float32")
+    loc = fluid.layers.reshape(
+        fluid.layers.fc(input=img_feat, size=P * 4,
+                        param_attr=fluid.ParamAttr(name="loc_w")),
+        shape=[-1, P, 4], inplace=False)
+    conf = fluid.layers.reshape(
+        fluid.layers.fc(input=img_feat, size=P * C,
+                        param_attr=fluid.ParamAttr(name="conf_w")),
+        shape=[-1, P, C], inplace=False)
+    gt_box = fluid.layers.data(name="gt_box", shape=[4], dtype="float32",
+                               lod_level=1)
+    gt_label = fluid.layers.data(name="gt_label", shape=[1], dtype="int64",
+                                 lod_level=1)
+    prior = fluid.layers.data(name="prior", shape=[P, 4],
+                              append_batch_size=False, dtype="float32")
+    pvar = fluid.layers.data(name="pvar", shape=[P, 4],
+                             append_batch_size=False, dtype="float32")
+    loss = fluid.layers.ssd_loss(loc, conf, gt_box, gt_label, prior, pvar)
+    avg = fluid.layers.mean(loss)
+    return avg, loc, conf
+
+
+def test_ssd_loss_trains():
+    """End-to-end: ssd_loss builds, runs, and its gradients train the
+    loc/conf heads (loss decreases)."""
+    P, C = 8, 3
+    rng = np.random.RandomState(0)
+    prior = np.zeros((P, 4), np.float32)
+    for i in range(P):
+        x0, y0 = (i % 4) * 0.25, (i // 4) * 0.5
+        prior[i] = [x0, y0, x0 + 0.25, y0 + 0.5]
+    pvar = np.full((P, 4), 0.1, np.float32)
+
+    with program_guard(Program(), Program()):
+        avg, _, _ = _ssd_program(P, C)
+        opt = fluid.optimizer.SGD(learning_rate=0.05)
+        opt.minimize(avg)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        losses = []
+        feat = rng.randn(2, P * 4).astype(np.float32)  # fixed: fit exactly
+        for step in range(30):
+            # one gt per image, near a prior cell
+            gtb = np.asarray([[0.05, 0.1, 0.2, 0.45],
+                              [0.55, 0.55, 0.72, 0.95]], np.float32)
+            gtl = np.asarray([[1], [2]], np.int64)
+            box_lt = fluid.create_lod_tensor(gtb, [[1, 1]], fluid.CPUPlace())
+            lbl_lt = fluid.create_lod_tensor(gtl, [[1, 1]], fluid.CPUPlace())
+            out, = exe.run(feed={"feat": feat, "gt_box": box_lt,
+                                 "gt_label": lbl_lt, "prior": prior,
+                                 "pvar": pvar},
+                           fetch_list=[avg])
+            losses.append(float(np.asarray(out).reshape(())))
+    assert all(np.isfinite(losses)), losses
+    assert np.mean(losses[-5:]) < 0.7 * np.mean(losses[:5]), (
+        losses[:5], losses[-5:])
+
+
+def test_detection_output_pipeline():
+    P, C = 4, 3
+    prior = np.asarray([[0.0, 0.0, 0.5, 0.5], [0.5, 0.0, 1.0, 0.5],
+                        [0.0, 0.5, 0.5, 1.0], [0.5, 0.5, 1.0, 1.0]],
+                       np.float32)
+    pvar = np.ones((P, 4), np.float32)
+    with program_guard(Program(), Program()):
+        loc = fluid.layers.data(name="loc", shape=[P, 4],
+                                append_batch_size=False, dtype="float32")
+        scores = fluid.layers.data(name="scores", shape=[1, P, C],
+                                   append_batch_size=False, dtype="float32")
+        prior_v = fluid.layers.data(name="prior", shape=[P, 4],
+                                    append_batch_size=False, dtype="float32")
+        pvar_v = fluid.layers.data(name="pvar", shape=[P, 4],
+                                   append_batch_size=False, dtype="float32")
+        det = fluid.layers.detection_output(
+            loc, scores, prior_v, pvar_v, score_threshold=0.3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = np.zeros((1, P, C), np.float32)
+        sc[0, 0] = [0.05, 0.9, 0.05]   # prior0 strongly class 1
+        sc[0, 3] = [0.1, 0.1, 0.8]     # prior3 strongly class 2
+        out, = exe.run(
+            feed={"loc": np.zeros((P, 4), np.float32).reshape(P, 4),
+                  "scores": sc, "prior": prior, "pvar": pvar},
+            fetch_list=[det], return_numpy=False)
+    rows = np.asarray(out)
+    assert rows.shape[0] == 2
+    labels = sorted(rows[:, 0].tolist())
+    assert labels == [1.0, 2.0]
+    # zero offsets decode back to the priors themselves
+    got = rows[np.argsort(rows[:, 0])][:, 2:]
+    np.testing.assert_allclose(got[0], prior[0], atol=1e-5)
+    np.testing.assert_allclose(got[1], prior[3], atol=1e-5)

@@ -1,0 +1,107 @@
+"""User-facing sharding rules: set_sharding + ParallelExecutor mesh_shape.
+
+Covers SURVEY §2.4's tensor/model-parallel row: parameters annotated with
+mesh-axis names are placed as NamedShardings on a multi-axis mesh and XLA
+inserts the tensor-parallel collectives. Runs on the virtual 8-device CPU
+mesh (conftest).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program, program_guard
+from paddle_tpu.parallel import set_sharding, get_sharding
+
+
+def _build(hidden=32):
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=x, size=hidden, act="relu",
+                        param_attr=fluid.ParamAttr(name="w1"))
+    probs = fluid.layers.fc(input=h, size=10, act="softmax",
+                            param_attr=fluid.ParamAttr(name="w2"))
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=probs, label=label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_set_sharding_validation():
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=2,
+                            param_attr=fluid.ParamAttr(name="W"))
+        w = fluid.default_main_program().global_block().var("W")
+        set_sharding(w, (None, "mp"))
+        assert get_sharding(w) == (None, "mp")
+        with pytest.raises(ValueError, match="longer than"):
+            set_sharding(w, (None, "mp", "dp"))
+        with pytest.raises(TypeError):
+            set_sharding(w, (3,))
+        with pytest.raises(TypeError):
+            set_sharding("W", (None,))
+
+
+def test_tensor_parallel_training_matches_replicated():
+    """w1 column-sharded over mp on a dp*mp mesh: same losses as the plain
+    replicated executor, and the state actually lands sharded."""
+    rng = np.random.RandomState(0)
+    xv = rng.randn(8, 16).astype(np.float32)
+    yv = rng.randint(0, 10, (8, 1)).astype(np.int64)
+
+    def run(sharded):
+        with program_guard(Program(), Program()):
+            with fluid.scope_guard(fluid.Scope()):
+                loss = _build()
+                gb = fluid.default_main_program().global_block()
+                if sharded:
+                    set_sharding(gb.var("w1"), (None, "mp"))
+                    set_sharding(gb.var("w2"), ("mp", None))
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(fluid.default_startup_program())
+                pe = fluid.ParallelExecutor(
+                    use_cuda=False, loss_name=loss.name,
+                    mesh_shape={"dp": 2, "mp": 4} if sharded else None)
+                losses = []
+                for _ in range(4):
+                    out, = pe.run(fetch_list=[loss],
+                                  feed={"x": xv, "label": yv})
+                    losses.append(float(np.asarray(out).reshape(())))
+                w1 = fluid.executor.fetch_var("w1", return_numpy=False)
+                return losses, w1
+
+    base, _ = run(sharded=False)
+    got, w1 = run(sharded=True)
+    np.testing.assert_allclose(got, base, rtol=1e-4, atol=1e-5)
+    # the parameter really lives column-sharded over mp
+    spec = w1.sharding.spec
+    assert tuple(spec) == (None, "mp"), spec
+    assert not w1.sharding.is_fully_replicated
+
+
+def test_mesh_shape_validation():
+    with program_guard(Program(), Program()):
+        loss = _build()
+        with pytest.raises(ValueError, match="devices"):
+            fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                   mesh_shape={"dp": 3, "mp": 5})
+
+
+def test_bad_divisibility_raises():
+    with program_guard(Program(), Program()):
+        with fluid.scope_guard(fluid.Scope()):
+            loss = _build(hidden=30)  # 30 % 4 != 0
+            gb = fluid.default_main_program().global_block()
+            set_sharding(gb.var("w1"), (None, "mp"))
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                        mesh_shape={"dp": 2, "mp": 4})
+            rng = np.random.RandomState(0)
+            with pytest.raises(ValueError, match="not divisible"):
+                pe.run(fetch_list=[loss],
+                       feed={"x": rng.randn(8, 16).astype(np.float32),
+                             "label": np.zeros((8, 1), np.int64)})
