@@ -47,7 +47,27 @@ USE_AMP = os.environ.get("BENCH_AMP", "1") != "0"
 PIPELINE_STEPS = int(os.environ.get("BENCH_PIPELINE_STEPS", 6))
 
 
-def measure_pipeline(fluid, main_prog, startup, loss_name):
+def _build_pipeline_program(fluid):
+    """Same ResNet-50 train step, but fed RAW uint8 pixels that are cast +
+    normalized on device (the TPU-idiomatic input path)."""
+    from paddle_tpu.models.resnet import resnet_imagenet
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        raw = fluid.layers.data(name="data_u8", shape=[3, 224, 224],
+                                dtype="uint8")
+        img = fluid.layers.scale(
+            fluid.layers.cast(raw, "float32"), scale=1.0 / 255.0)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        predict = resnet_imagenet(img, 1000, depth=50)
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=predict, label=label))
+        fluid.optimizer.Momentum(
+            learning_rate=0.01, momentum=0.9).minimize(loss)
+    return prog, startup, loss
+
+
+def measure_pipeline(fluid):
     """RecordIO -> double-buffer decode -> feed -> step, images/s."""
     from paddle_tpu import recordio
     from paddle_tpu.reader import decorator
@@ -66,29 +86,33 @@ def measure_pipeline(fluid, main_prog, startup, loss_name):
 
     def batches():
         for rec in recordio.Scanner(path):
-            img = np.frombuffer(rec[:img_bytes], np.uint8)
-            img = (img.astype(np.float32) / 255.0).reshape(
+            # ship uint8 across the host->device link and normalize ON
+            # DEVICE (the data_u8 feed of _build_pipeline_program): 4x less
+            # transfer than f32 — on this host the link is the chip tunnel,
+            # so this decides whether the pipeline is link-bound
+            img = np.frombuffer(rec[:img_bytes], np.uint8).reshape(
                 BATCH, 3, 224, 224)
             lbl = np.frombuffer(rec[img_bytes:], np.int64).reshape(BATCH, 1)
             yield img, lbl
 
     reader = decorator.buffered(batches, 2)  # decode on a prefetch thread
+    pipe_prog, pipe_startup, pipe_loss = _build_pipeline_program(fluid)
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe = fluid.Executor(fluid.TPUPlace(0))
-        exe.run(startup)
+        exe.run(pipe_startup)
         it = reader()
         for k in range(3):  # compile + warm BOTH fetch variants
             img, lbl = next(it)
-            fl = [loss_name] if k == 2 else []
-            exe.run(main_prog, feed={"data": img, "label": lbl},
+            fl = [pipe_loss.name] if k == 2 else []
+            exe.run(pipe_prog, feed={"data_u8": img, "label": lbl},
                     fetch_list=fl)
         t0 = time.time()
         out = None
         for i in range(PIPELINE_STEPS):
             img, lbl = next(it)
-            fl = [loss_name] if i == PIPELINE_STEPS - 1 else []
-            out = exe.run(main_prog, feed={"data": img, "label": lbl},
+            fl = [pipe_loss.name] if i == PIPELINE_STEPS - 1 else []
+            out = exe.run(pipe_prog, feed={"data_u8": img, "label": lbl},
                           fetch_list=fl)
         lv = float(np.asarray(out[0]).item())  # fences the queue
         dt = time.time() - t0
@@ -184,12 +208,15 @@ def main():
         "unit": "images/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
     }
-    try:
-        pipe_s = measure_pipeline(fluid, main_prog, startup, loss.name)
-        result["pipeline_images_per_sec"] = round(pipe_s, 2)
-        result["pipeline_frac_of_device"] = round(pipe_s / img_s, 3)
-    except Exception as e:  # the headline metric must survive pipeline woes
-        result["pipeline_error"] = f"{type(e).__name__}: {e}"
+    for attempt in range(2):  # tunneled remote_compile flakes transiently
+        try:
+            pipe_s = measure_pipeline(fluid)
+            result["pipeline_images_per_sec"] = round(pipe_s, 2)
+            result["pipeline_frac_of_device"] = round(pipe_s / img_s, 3)
+            result.pop("pipeline_error", None)
+            break
+        except Exception as e:  # headline metric must survive pipeline woes
+            result["pipeline_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

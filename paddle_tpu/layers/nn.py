@@ -16,6 +16,7 @@ __all__ = [
     "fc", "embedding", "dynamic_lstm", "dynamic_lstmp", "dynamic_gru",
     "gru_unit", "lstm_unit", "cos_sim", "cross_entropy", "square_error_cost",
     "accuracy", "auc", "chunk_eval", "sequence_conv", "conv2d", "conv3d",
+    "sequence_concat",
     "sequence_pool", "sequence_softmax", "softmax", "pool2d", "batch_norm",
     "layer_norm", "beam_search_decode", "conv2d_transpose", "sequence_expand",
     "beam_search", "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
@@ -362,6 +363,22 @@ def sequence_pool(input, pool_type):
         {"pooltype": pool_type.upper()},
     )
     return pool_out
+
+
+def sequence_concat(input, axis=1, name=None):
+    """reference layers/nn.py sequence_concat: join sequences feature-wise
+    (axis=1, equal lod) or time-wise (axis=0, appending pairwise)."""
+    helper = LayerHelper("sequence_concat", **locals())
+    shape = None
+    if axis == 1 and all(
+            v.shape is not None and isinstance(v.shape[-1], int)
+            and v.shape[-1] > 0 for v in input):
+        shape = (-1, int(sum(v.shape[-1] for v in input)))
+    out = helper.create_tmp_variable(dtype=helper.input_dtype(), shape=shape,
+                                     lod_level=input[0].lod_level)
+    helper.append_op("sequence_concat", {"X": list(input)}, {"Out": [out]},
+                     {"axis": axis})
+    return out
 
 
 def sequence_first_step(input):

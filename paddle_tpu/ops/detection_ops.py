@@ -285,11 +285,9 @@ def target_assign_op(ctx, ins, attrs):
     off = 0
     for b in range(B):
         L = int(lengths[b]) if b < len(lengths) else 0
-        for p in range(P):
-            m = match[b, p]
-            if m >= 0:
-                outv[b, p] = data[off + m]
-                w[b, p] = 1.0
+        sel = match[b] >= 0  # vectorized: this runs twice per train step
+        outv[b, sel] = data[off + match[b, sel]]
+        w[b, sel, 0] = 1.0
         off += L
     if neg is not None:
         nrows = np.asarray(neg.data).reshape(-1)

@@ -1,0 +1,140 @@
+"""Book-chapter NLP models over the new datasets: sentiment (stacked LSTM
+classifier on dataset.sentiment) and semantic role labeling (CRF tagger on
+dataset.conll05).
+
+Reference: python/paddle/fluid/tests/book/test_understand_sentiment.py and
+test_label_semantic_roles.py — the model families those chapters train,
+scaled to test size with the zero-egress synthetic datasets.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program, program_guard
+
+
+def _batches(reader, batch_size):
+    """Group samples into full batches (the final partial batch is
+    dropped); callers fix sequence length via np.resize per batch."""
+    batch = []
+    for sample in reader():
+        batch.append(sample)
+        if len(batch) == batch_size:
+            yield batch
+            batch = []
+
+
+@pytest.mark.slow
+def test_understand_sentiment_lstm_trains():
+    from paddle_tpu.dataset import sentiment
+
+    VOCAB_RAW, VOCAB = 39768, 200  # compress ids, keeping class halves
+    T, B, EMB, HID = 48, 16, 24, 32
+    with program_guard(Program(), Program()):
+        words = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(words, size=[VOCAB, EMB])
+        proj = fluid.layers.fc(input=emb, size=HID * 4)
+        lstm = fluid.layers.dynamic_lstm(proj, size=HID * 4,
+                                         use_peepholes=False, max_len=T)
+        # average over time: the synthetic dataset's signal is unigram
+        # class bias, which last-state pooling dilutes
+        pooled = fluid.layers.sequence_pool(
+            lstm[0] if isinstance(lstm, tuple) else lstm,
+            pool_type="average")
+        bow = fluid.layers.sequence_pool(emb, pool_type="average")
+        feat = fluid.layers.concat([pooled, bow], axis=1)
+        probs = fluid.layers.fc(input=feat, size=2, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=probs, label=label))
+        acc = fluid.layers.accuracy(input=probs, label=label)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        losses, accs = [], []
+        for i, batch in enumerate(
+                _batches(sentiment.train(), B)):
+            if i >= 40:
+                break
+            toks = [np.resize(np.asarray(w) * VOCAB // VOCAB_RAW, T)
+                    for w, _ in batch]
+            flat = np.concatenate(toks).reshape(-1, 1)
+            lt = fluid.create_lod_tensor(flat, [[T] * B], fluid.CPUPlace())
+            lbl = np.asarray([[y] for _, y in batch], np.int64)
+            lv, av = exe.run(feed={"words": lt, "label": lbl},
+                             fetch_list=[loss, acc])
+            losses.append(float(np.asarray(lv).reshape(())))
+            accs.append(float(np.asarray(av).reshape(())))
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), (losses[:5],
+                                                        losses[-5:])
+    assert np.mean(accs[-5:]) > 0.55, accs  # better than chance
+
+
+@pytest.mark.slow
+def test_label_semantic_roles_crf_trains():
+    from paddle_tpu.dataset import conll05
+
+    WORD_V = conll05.WORD_DICT_LEN
+    LABELS = conll05.LABEL_DICT_LEN
+    T, B, EMB, HID = 24, 8, 16, 32
+
+    with program_guard(Program(), Program()):
+        word = fluid.layers.data(name="word", shape=[1], dtype="int64",
+                                 lod_level=1)
+        mark = fluid.layers.data(name="mark", shape=[1], dtype="int64",
+                                 lod_level=1)
+        target = fluid.layers.data(name="target", shape=[1], dtype="int64",
+                                   lod_level=1)
+        w_emb = fluid.layers.embedding(word, size=[WORD_V, EMB])
+        m_emb = fluid.layers.embedding(mark, size=[2, 4])
+        feat = fluid.layers.sequence_concat([w_emb, m_emb], axis=1)
+        hidden = fluid.layers.fc(input=feat, size=HID, act="tanh")
+        emission = fluid.layers.fc(input=hidden, size=LABELS)
+        crf_cost = fluid.layers.linear_chain_crf(
+            emission, target,
+            param_attr=fluid.ParamAttr(name="crfw"))
+        loss = fluid.layers.mean(crf_cost)
+        decode = fluid.layers.crf_decoding(
+            emission, param_attr=fluid.ParamAttr(name="crfw"))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+
+        def batches():
+            it = conll05.test()()
+            while True:
+                chunk = []
+                for _ in range(B):
+                    s = next(it)
+                    chunk.append(s)
+                yield chunk
+
+        losses = []
+        gen = batches()
+        for step in range(15):
+            chunk = next(gen)
+            words = np.concatenate(
+                [np.resize(np.asarray(s[0]), T) for s in chunk]).reshape(-1, 1)
+            marks = np.concatenate(
+                [np.resize(np.asarray(s[7]), T) for s in chunk]).reshape(-1, 1)
+            labels = np.concatenate(
+                [np.resize(np.asarray(s[8]), T) for s in chunk]).reshape(-1, 1)
+            lod = [[T] * B]
+            place = fluid.CPUPlace()
+            lv, dec = exe.run(
+                feed={"word": fluid.create_lod_tensor(words, lod, place),
+                      "mark": fluid.create_lod_tensor(marks, lod, place),
+                      "target": fluid.create_lod_tensor(labels, lod, place)},
+                fetch_list=[loss, decode], return_numpy=False)
+            losses.append(float(np.asarray(lv).reshape(())))
+        dec_np = np.asarray(dec)
+        assert dec_np.shape[0] == B * T  # a tag per token
+        assert dec_np.min() >= 0 and dec_np.max() < LABELS
+    assert all(np.isfinite(losses)), losses
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), (losses[:3],
+                                                        losses[-3:])
