@@ -94,28 +94,36 @@ def sequence_concat_op(ctx, ins, attrs):
     if axis == 1:
         o = jnp.concatenate([s.data for s in xs], axis=-1)
         return out(Out=SeqTensor(o, xs[0].lengths))
-    # axis=0: append sequences pairwise
-    datas = [s.data for s in xs]
-    lens = [s.lengths for s in xs]
-    # interleave per sequence: gather-based merge
-    total = sum(d.shape[0] for d in datas)
-    data = jnp.concatenate(datas, axis=0)
-    n0 = datas[0].shape[0]
-    B = xs[0].batch
-    new_lengths = sum(lens)
-    # build gather index: for each output slot, pick from x0 part or x1 part
-    offs = [s.offsets() for s in xs]
-    new_off = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(new_lengths)])
-    pos = jnp.arange(total)
-    seq_id = jnp.searchsorted(jnp.cumsum(new_lengths), pos, side="right")
-    seq_id = jnp.clip(seq_id, 0, B - 1)
-    local = pos - new_off[seq_id]
-    in_first = local < lens[0][seq_id]
-    idx0 = offs[0][seq_id] + local
-    idx1 = n0 + offs[1][seq_id] + (local - lens[0][seq_id])
-    gather_idx = jnp.where(in_first, idx0, jnp.clip(idx1, 0, total - 1))
-    o = jnp.take(data, jnp.clip(gather_idx, 0, total - 1), axis=0)
-    return out(Out=SeqTensor(o, new_lengths))
+
+    # axis=0: append sequences pairwise; N inputs fold left through the
+    # 2-way merge (a naive concat would misplace every input past the
+    # second)
+    def merge_two(a, b):
+        datas = [a.data, b.data]
+        lens = [a.lengths, b.lengths]
+        total = sum(d.shape[0] for d in datas)
+        data = jnp.concatenate(datas, axis=0)
+        n0 = datas[0].shape[0]
+        B = a.batch
+        new_lengths = lens[0] + lens[1]
+        offs = [a.offsets(), b.offsets()]
+        new_off = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(new_lengths)])
+        pos = jnp.arange(total)
+        seq_id = jnp.searchsorted(jnp.cumsum(new_lengths), pos, side="right")
+        seq_id = jnp.clip(seq_id, 0, B - 1)
+        local = pos - new_off[seq_id]
+        in_first = local < lens[0][seq_id]
+        idx0 = offs[0][seq_id] + local
+        idx1 = n0 + offs[1][seq_id] + (local - lens[0][seq_id])
+        gather_idx = jnp.where(in_first, idx0, jnp.clip(idx1, 0, total - 1))
+        o = jnp.take(data, jnp.clip(gather_idx, 0, total - 1), axis=0)
+        return SeqTensor(o, new_lengths)
+
+    acc = xs[0]
+    for nxt in xs[1:]:
+        acc = merge_two(acc, nxt)
+    return out(Out=acc)
 
 
 @register_op("sequence_conv", lod_aware=True)

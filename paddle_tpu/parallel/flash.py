@@ -1,0 +1,236 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot-op kernel path the reference implements with cuDNN/hand-written
+CUDA: exact attention computed block-by-block in VMEM with the streaming
+softmax (running max + normalizer), never materializing the [S, S] score
+matrix in HBM. Complements parallel/ring.py: ring attention shards the
+sequence ACROSS chips and streams K/V around the ICI ring; flash_attention
+is the WITHIN-chip kernel.
+
+Layout [B, H, S, D]. The kernel runs a (batch*heads, q-blocks, k-blocks)
+grid with the k dimension innermost ("arbitrary" semantics — sequential
+per core) carrying the running (m, l, acc) in VMEM scratch. The backward
+pass is a blockwise lax.scan in plain JAX using the saved logsumexp —
+O(S * block) live memory — wired through jax.custom_vjp.
+
+Off-TPU (CPU tests) the kernel runs in Pallas interpret mode.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+            scale, causal, block_q, block_k, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _accumulate():
+        q = q_ref[0]                   # [bq, D]
+        k = k_ref[0]                   # [bk, D]
+        v = v_ref[0]                   # [bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+
+        m_prev = m_scr[:]              # [bq]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)  # masked rows
+        p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - m_safe[:, None]))
+        corr = jnp.where(jnp.isneginf(m_prev), 0.0, jnp.exp(m_prev - m_safe))
+        l_scr[:] = corr * l_scr[:] + jnp.sum(p, axis=1)
+        acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    if causal:
+        # skip k-blocks entirely above the causal frontier (half the grid)
+        pl.when(qi * block_q + block_q - 1 >= ki * block_k)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        lse = jnp.where(
+            jnp.isneginf(m_scr[:]), -jnp.inf, m_scr[:] + jnp.log(l))
+        # lse rides in an [8, block_q] tile: Mosaic requires the last two
+        # block dims to be (8, 128)-aligned, so broadcast over 8 sublanes
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    """q [BH, Sq, D] (Sq % block_q == 0), k/v [BH, Sk, D] (Sk % block_k
+    == 0) -> (out [BH, Sq, D], lse [BH, Sq])."""
+    BH, Sq, Dq = q.shape  # Dq may carry the +1 padding-mask channel
+    Sk = k.shape[1]
+    Dv = v.shape[-1]
+    nq, nk = Sq // block_q, Sk // block_k
+    kernel = functools.partial(
+        _kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nk=nk)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, Dq), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, Dq), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, Dv), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, Dv), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, Dv), q.dtype),
+            jax.ShapeDtypeStruct((BH, 8, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, Dv), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        # device platform, not backend name: the tunneled TPU platform
+        # registers as backend "axon" with devices of platform "tpu"
+        interpret=jax.devices()[0].platform != "tpu",
+    )(q, k, v)
+
+
+def _fwd_padded(q, k, v, scale, causal, block_q, block_k):
+    """Pad S to block multiples; padded KEYS are neutralized by extending D
+    with a bias channel (q gains a 1, real keys a 0, padded keys -BIG), so
+    their scores vanish under exp without any in-kernel mask plumbing."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    pad_q = (-Sq) % block_q
+    pad_k = (-Sk) % block_k
+    qw, kw, vw = q, k, v
+    if pad_q:
+        qw = jnp.pad(qw, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kw = jnp.pad(kw, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        vw = jnp.pad(vw, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        BIG = jnp.asarray(3e4 / max(scale, 1e-6), jnp.float32).astype(q.dtype)
+        qw = jnp.concatenate([qw, jnp.ones_like(qw[..., :1])], axis=-1)
+        maskch = jnp.where(
+            (jnp.arange(kw.shape[2]) < Sk)[None, None, :, None],
+            jnp.zeros((), q.dtype), -BIG)
+        kw = jnp.concatenate(
+            [kw, jnp.broadcast_to(maskch, kw.shape[:3] + (1,))], axis=-1)
+    BH = B * H
+    Dk = qw.shape[-1]
+    out, lse = _flash_fwd(
+        qw.reshape(BH, Sq + pad_q, Dk), kw.reshape(BH, Sk + pad_k, Dk),
+        vw.reshape(BH, Sk + pad_k, D), scale, causal, block_q, block_k)
+    out = out.reshape(B, H, Sq + pad_q, D)[:, :, :Sq]
+    lse = lse[:, 0, :].reshape(B, H, Sq + pad_q)[:, :, :Sq]
+    return out, lse
+
+
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=256, block_k=256):
+    """Exact attention [B, H, S, D] -> [B, H, S, D]; differentiable.
+
+    Defaults (256, 256) measured fastest on a v5e chip at S=1024 D=128 —
+    faster than XLA's fused dense attention there, with O(S * block) memory
+    instead of the dense [S, S] score matrix (S >= 16k runs comfortably).
+    Blocks auto-shrink for short sequences."""
+    # Mosaic block-alignment rule: every block dim must be (8, 128)-aligned
+    # in its (sublane, lane) position OR equal to the (padded) array dim.
+    # So a block is legal when it is a multiple of 128 (the lse tile's lane
+    # dim) or when it covers the whole padded sequence (n=1). Auto-shrink
+    # short sequences to a single 8-rounded block; round user blocks up to
+    # 128 when compiling for real TPU (interpret mode has no constraint).
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    def _pick(block, S):
+        S8 = -(-max(S, 1) // 8) * 8
+        block = int(block)
+        if on_tpu and block % 128:
+            block = -(-block // 128) * 128
+        return S8 if block >= S8 else block
+
+    block_q = _pick(block_q, q.shape[2])
+    block_k = _pick(block_k, k.shape[2])
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash(q, k, v, float(scale), bool(causal),
+                  int(block_q), int(block_k))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _fwd_padded(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _fwd_padded(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), -1)
+
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+
+    nk = (Sk + block_k - 1) // block_k
+    pad = nk * block_k - Sk
+    if pad:
+        kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = jnp.moveaxis(kf.reshape(B, H, nk, block_k, D), 2, 0)
+    vb = jnp.moveaxis(vf.reshape(B, H, nk, block_k, D), 2, 0)
+
+    def body(dq, blk):
+        kblk, vblk, j = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kblk) * scale
+        pos = j * block_k + jnp.arange(block_k)
+        valid = pos < Sk
+        if causal:
+            mask = valid[None, :] & (pos[None, :] <= jnp.arange(S)[:, None])
+        else:
+            mask = jnp.broadcast_to(valid[None, :], (S, block_k))
+        p = jnp.where(mask[None, None], jnp.exp(s - lse[..., None]), 0.0)
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vblk)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kblk)
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq, (dk, dv)
+
+    dq, (dks, dvs) = jax.lax.scan(
+        body, jnp.zeros_like(qf), (kb, vb, jnp.arange(nk)))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(B, H, nk * block_k, D)[:, :, :Sk]
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(B, H, nk * block_k, D)[:, :, :Sk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -14,17 +14,6 @@ import paddle_tpu as fluid
 from paddle_tpu.core.framework import Program, program_guard
 
 
-def _batches(reader, batch_size):
-    """Group samples into full batches (the final partial batch is
-    dropped); callers fix sequence length via np.resize per batch."""
-    batch = []
-    for sample in reader():
-        batch.append(sample)
-        if len(batch) == batch_size:
-            yield batch
-            batch = []
-
-
 @pytest.mark.slow
 def test_understand_sentiment_lstm_trains():
     from paddle_tpu.dataset import sentiment
@@ -55,8 +44,9 @@ def test_understand_sentiment_lstm_trains():
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(fluid.default_startup_program())
         losses, accs = [], []
-        for i, batch in enumerate(
-                _batches(sentiment.train(), B)):
+        reader = fluid.batch(sentiment.train(), batch_size=B,
+                             drop_last=True)
+        for i, batch in enumerate(reader()):
             if i >= 40:
                 break
             toks = [np.resize(np.asarray(w) * VOCAB // VOCAB_RAW, T)
@@ -105,19 +95,11 @@ def test_label_semantic_roles_crf_trains():
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(fluid.default_startup_program())
 
-        def batches():
-            it = conll05.test()()
-            while True:
-                chunk = []
-                for _ in range(B):
-                    s = next(it)
-                    chunk.append(s)
-                yield chunk
-
+        reader = fluid.batch(conll05.test(), batch_size=B, drop_last=True)
         losses = []
-        gen = batches()
-        for step in range(15):
-            chunk = next(gen)
+        for step, chunk in enumerate(reader()):
+            if step >= 15:
+                break
             words = np.concatenate(
                 [np.resize(np.asarray(s[0]), T) for s in chunk]).reshape(-1, 1)
             marks = np.concatenate(

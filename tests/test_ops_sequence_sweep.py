@@ -232,3 +232,17 @@ def test_im2sequence():
     _T("im2sequence", {"X": x},
        {"Out": (want.astype(np.float32), [[0, 4, 8]])},
        {"kernels": [kh, kw], "strides": [sh, sw]}).check_output(atol=1e-5)
+
+
+def test_sequence_concat_time_axis_three_inputs():
+    """N>2 inputs must fold through the pairwise merge — a naive concat
+    misplaces every input past the second."""
+    a = np.asarray([[1.0], [2.0], [3.0]], np.float32)   # lens [2, 1]
+    b = np.asarray([[10.0], [20.0], [30.0]], np.float32)  # lens [1, 2]
+    c = np.asarray([[100.0], [200.0]], np.float32)      # lens [1, 1]
+    want = np.asarray([[1], [2], [10], [100],
+                       [3], [20], [30], [200]], np.float32)
+    _T("sequence_concat",
+       {"X": [("a", (a, [[0, 2, 3]])), ("b", (b, [[0, 1, 3]])),
+              ("c", (c, [[0, 1, 2]]))]},
+       {"Out": (want, [[0, 4, 8]])}, {"axis": 0}).check_output()
