@@ -1,0 +1,68 @@
+"""Program dump + graphviz export (reference debuger.py / graphviz.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program, program_guard
+from paddle_tpu import debugger
+
+
+def _model():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    probs = fluid.layers.fc(input=x, size=3, act="softmax",
+                            param_attr=fluid.ParamAttr(name="W"))
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=probs, label=label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_pprint_and_graphviz(tmp_path):
+    with program_guard(Program(), Program()):
+        _model()
+        prog = fluid.default_main_program()
+        text = debugger.pprint_program_codes(prog, show_backward=True,
+                                             show_attrs=True)
+        assert "mul(" in text and "sgd(" in text
+        assert "param W" in text
+
+        dot = open(debugger.draw_block_graphviz(
+            prog.global_block(), highlights=["W"],
+            path=str(tmp_path / "b.dot"))).read()
+        assert "digraph G" in dot
+        assert 'fillcolor="red"' in dot          # highlighted var
+        assert 'fillcolor="#b19cd9"' in dot      # optimize role color
+        assert 'label="Param"' in dot            # slot-labeled edge
+        assert "float32[4x3]" in dot             # typed var label
+
+        dot2 = open(debugger.draw_program_graphviz(
+            prog, path=str(tmp_path / "p.dot"))).read()
+        assert "digraph G" in dot2
+
+
+def test_program_graphviz_subblocks(tmp_path):
+    with program_guard(Program(), Program()):
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype="int64", value=3)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            fluid.layers.increment(x=i, value=1, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+        dot = open(debugger.draw_program_graphviz(
+            fluid.default_main_program(),
+            path=str(tmp_path / "w.dot"))).read()
+    assert "cluster_1" in dot and "block 1" in dot
+
+
+def test_loss_grad_op_colored_backward(tmp_path):
+    """The Backward|Loss role (the loss-grad fill op) must not render as a
+    forward op."""
+    with program_guard(Program(), Program()):
+        _model()
+        dot = open(debugger.draw_block_graphviz(
+            fluid.default_main_program().global_block(),
+            path=str(tmp_path / "roles.dot"))).read()
+    # fill-constant loss-grad op exists and backward color appears
+    assert 'fillcolor="#ffb347"' in dot
