@@ -127,6 +127,18 @@ def _emit_block(block, lines, prefix, highlights, drawn_vars):
                 f'  "{_esc(name)}" [shape=oval, style=filled, '
                 f'fillcolor="{fill}", label="{_esc(_var_label(v))}"];')
         drawn_vars.add(name)
+    # vars declared in the block but not (yet) wired to any op still get a
+    # node — a highlighted feed var with no consumer must not vanish
+    for name, v in block.vars.items():
+        if name in drawn_vars:
+            continue
+        fill = "red" if name in highlights else (
+            "gold" if isinstance(v, Parameter) else (
+                "lightblue" if v.persistable else "white"))
+        lines.append(
+            f'  "{_esc(name)}" [shape=oval, style=filled, '
+            f'fillcolor="{fill}", label="{_esc(_var_label(v))}"];')
+        drawn_vars.add(name)
     return used
 
 

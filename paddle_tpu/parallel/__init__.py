@@ -17,7 +17,9 @@ from . import sharded_embedding
 from . import flash
 from . import api
 from .mesh import make_mesh, data_parallel_mesh, mesh_scope
-from .ring import ring_attention, ring_attention_sharded
+from .ring import (ring_attention, ring_attention_sharded,
+                   ring_flash_attention,
+                   ring_flash_attention_sharded)
 from .sharded_embedding import shard_table, sharded_embedding_lookup
 from .api import set_sharding, get_sharding
 from .flash import flash_attention
@@ -27,6 +29,7 @@ __all__ = [
     "flash",
     "make_mesh", "data_parallel_mesh", "mesh_scope",
     "ring_attention", "ring_attention_sharded",
+    "ring_flash_attention", "ring_flash_attention_sharded",
     "shard_table", "sharded_embedding_lookup",
     "set_sharding", "get_sharding", "flash_attention",
 ]

@@ -148,20 +148,14 @@ def _fwd_padded(q, k, v, scale, causal, block_q, block_k):
     return out, lse
 
 
-def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=256, block_k=256):
-    """Exact attention [B, H, S, D] -> [B, H, S, D]; differentiable.
-
-    Defaults (256, 256) measured fastest on a v5e chip at S=1024 D=128 —
-    faster than XLA's fused dense attention there, with O(S * block) memory
-    instead of the dense [S, S] score matrix (S >= 16k runs comfortably).
-    Blocks auto-shrink for short sequences."""
-    # Mosaic block-alignment rule: every block dim must be (8, 128)-aligned
-    # in its (sublane, lane) position OR equal to the (padded) array dim.
-    # So a block is legal when it is a multiple of 128 (the lse tile's lane
-    # dim) or when it covers the whole padded sequence (n=1). Auto-shrink
-    # short sequences to a single 8-rounded block; round user blocks up to
-    # 128 when compiling for real TPU (interpret mode has no constraint).
+def normalize_blocks(block_q, block_k, Sq, Sk):
+    """Mosaic block-alignment rule: every block dim must be (8, 128)-aligned
+    in its (sublane, lane) position OR equal to the (padded) array dim. So a
+    block is legal when it is a multiple of 128 (the lse tile's lane dim) or
+    when it covers the whole padded sequence (n=1). Auto-shrink short
+    sequences to a single 8-rounded block; round user blocks up to 128 when
+    compiling for real TPU (interpret mode has no constraint). Callers that
+    reach _fwd_padded directly (ring_flash_attention) must use this too."""
     on_tpu = jax.devices()[0].platform == "tpu"
 
     def _pick(block, S):
@@ -171,8 +165,19 @@ def flash_attention(q, k, v, causal=False, scale=None,
             block = -(-block // 128) * 128
         return S8 if block >= S8 else block
 
-    block_q = _pick(block_q, q.shape[2])
-    block_k = _pick(block_k, k.shape[2])
+    return _pick(block_q, Sq), _pick(block_k, Sk)
+
+
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=256, block_k=256):
+    """Exact attention [B, H, S, D] -> [B, H, S, D]; differentiable.
+
+    Defaults (256, 256) measured fastest on a v5e chip at S=1024 D=128 —
+    faster than XLA's fused dense attention there, with O(S * block) memory
+    instead of the dense [S, S] score matrix (S >= 16k runs comfortably).
+    Blocks auto-shrink for short sequences."""
+    block_q, block_k = normalize_blocks(block_q, block_k,
+                                        q.shape[2], k.shape[2])
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     return _flash(q, k, v, float(scale), bool(causal),

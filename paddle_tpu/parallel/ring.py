@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["ring_attention_sharded", "ring_attention"]
+__all__ = ["ring_attention_sharded", "ring_attention",
+           "ring_flash_attention_sharded", "ring_flash_attention"]
 
 
 def _block_attn(q, k, v, bias, m_prev, l_prev, o_prev, scale):
@@ -107,6 +108,102 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
     fn = jax.shard_map(
         functools.partial(ring_attention_sharded, axis_name=axis_name,
                           causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def ring_flash_attention_sharded(q, k, v, axis_name, causal=False,
+                                 scale=None, block_q=256, block_k=256):
+    """Ring attention with the Pallas flash kernel as the per-hop block
+    compute: K/V shards rotate over ICI while each hop's local attention
+    runs block-streaming in VMEM, so neither the global [S, S] scores nor a
+    per-hop [S_local, S_local] matrix ever exists in HBM. Exact (per-hop
+    (out, lse) pairs merge in log space).
+
+    Forward/serving path: the flash kernel's custom VJP does not propagate
+    through the log-space hop merge, so for training use ring_attention
+    (pure-jnp streaming, fully differentiable). Call inside shard_map over
+    `axis_name`; q,k,v: [B, H, S_local, D].
+    """
+    from .flash import _fwd_padded
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    scale = float(scale) if scale is not None else 1.0 / np.sqrt(D)
+
+    def hop_flash(k_blk, v_blk, case):
+        # case 0: fully visible hop (full attention)
+        # case 1: diagonal hop (causal within the shard)
+        # case 2: fully masked hop (skip)
+        def full(_):
+            return _fwd_padded(q, k_blk, v_blk, scale, False,
+                               block_q, block_k)
+
+        def diag(_):
+            return _fwd_padded(q, k_blk, v_blk, scale, True,
+                               block_q, block_k)
+
+        def skip(_):
+            return (jnp.zeros((B, H, S, D), q.dtype),
+                    jnp.full((B, H, S), -jnp.inf, jnp.float32))
+
+        if causal:
+            return lax.switch(case, [full, diag, skip], 0)
+        return full(0)
+
+    def merge(o_p, lse_p, o_h, lse_h):
+        lse_new = jnp.logaddexp(lse_p, lse_h)
+        safe = jnp.where(jnp.isneginf(lse_new), 0.0, lse_new)
+        w_p = jnp.where(jnp.isneginf(lse_p), 0.0, jnp.exp(lse_p - safe))
+        w_h = jnp.where(jnp.isneginf(lse_h), 0.0, jnp.exp(lse_h - safe))
+        o_new = w_p[..., None] * o_p.astype(jnp.float32) \
+            + w_h[..., None] * o_h.astype(jnp.float32)
+        return o_new, lse_new
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    o = jnp.zeros((B, H, S, D), jnp.float32)
+    lse = jnp.full((B, H, S), -jnp.inf, jnp.float32)
+
+    def hop(carry, hop_idx):
+        k_blk, v_blk, o, lse = carry
+        src = (idx - hop_idx) % n
+        case = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+        o_h, lse_h = hop_flash(k_blk, v_blk, case)
+        o, lse = merge(o, lse, o_h, lse_h)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, o, lse), None
+
+    if n > 1:
+        (k_blk, v_blk, o, lse), _ = lax.scan(
+            hop, (k, v, o, lse), jnp.arange(n - 1))
+        src = (idx - (n - 1)) % n
+        case = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+        o_h, lse_h = hop_flash(k_blk, v_blk, case)
+        o, lse = merge(o, lse, o_h, lse_h)
+    else:
+        o_h, lse_h = hop_flash(k, v, jnp.asarray(1 if causal else 0))
+        o, lse = merge(o, lse, o_h, lse_h)
+    return o.astype(q.dtype)
+
+
+def ring_flash_attention(q, k, v, mesh, axis_name="sp", causal=False,
+                         scale=None, block_q=256, block_k=256):
+    """Full-tensor entry for ring_flash_attention_sharded (see its
+    docstring; forward/serving path)."""
+    from .flash import normalize_blocks
+
+    # normalize against the PER-SHARD sequence length (what each hop's
+    # kernel actually sees), keeping Mosaic alignment + auto-shrink
+    s_local = q.shape[2] // mesh.shape[axis_name]
+    block_q, block_k = normalize_blocks(block_q, block_k, s_local, s_local)
+    spec = P(None, None, axis_name, None)
+    fn = jax.shard_map(
+        functools.partial(ring_flash_attention_sharded, axis_name=axis_name,
+                          causal=causal, scale=scale, block_q=block_q,
+                          block_k=block_k),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
