@@ -82,9 +82,20 @@ def _var_label(v):
     return f"{v.name}\\n{v.dtype}[{shape}]"
 
 
+def _var_fill(name, v, highlights):
+    from .core.framework import Parameter
+
+    if name in highlights:
+        return "red"
+    if isinstance(v, Parameter):
+        return "gold"
+    if v.persistable:
+        return "lightblue"
+    return "white"
+
+
 def _emit_block(block, lines, prefix, highlights, drawn_vars):
     """Emit one block's nodes/edges; returns var names referenced."""
-    from .core.framework import Parameter
 
     used = set()
     for i, op in enumerate(block.ops):
@@ -115,29 +126,20 @@ def _emit_block(block, lines, prefix, highlights, drawn_vars):
         if v is None:
             lines.append(f'  "{_esc(name)}" [shape=oval];')
         else:
-            if name in highlights:
-                fill = "red"
-            elif isinstance(v, Parameter):
-                fill = "gold"
-            elif v.persistable:
-                fill = "lightblue"
-            else:
-                fill = "white"
             lines.append(
                 f'  "{_esc(name)}" [shape=oval, style=filled, '
-                f'fillcolor="{fill}", label="{_esc(_var_label(v))}"];')
+                f'fillcolor="{_var_fill(name, v, highlights)}", '
+                f'label="{_esc(_var_label(v))}"];')
         drawn_vars.add(name)
     # vars declared in the block but not (yet) wired to any op still get a
     # node — a highlighted feed var with no consumer must not vanish
     for name, v in block.vars.items():
         if name in drawn_vars:
             continue
-        fill = "red" if name in highlights else (
-            "gold" if isinstance(v, Parameter) else (
-                "lightblue" if v.persistable else "white"))
         lines.append(
             f'  "{_esc(name)}" [shape=oval, style=filled, '
-            f'fillcolor="{fill}", label="{_esc(_var_label(v))}"];')
+            f'fillcolor="{_var_fill(name, v, highlights)}", '
+            f'label="{_esc(_var_label(v))}"];')
         drawn_vars.add(name)
     return used
 

@@ -12,7 +12,77 @@ from ..layer_helper import LayerHelper
 
 __all__ = ["box_coder", "iou_similarity", "prior_box", "bipartite_match",
            "target_assign", "mine_hard_examples", "ssd_loss",
-           "multiclass_nms", "detection_output"]
+           "multiclass_nms", "detection_output", "multi_box_head"]
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None):
+    """reference detection.py:679 — the SSD prediction head: per feature
+    map, a prior_box grid plus 1x1 conv loc/conf branches, flattened and
+    concatenated across maps.
+
+    Returns (mbox_locs [N, P_total, 4], mbox_confs [N, P_total, C],
+    boxes [P_total, 4], variances [P_total, 4])."""
+    import math
+
+    from . import nn
+    from . import tensor as tensor_layers
+
+    num_layer = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule: evenly spaced [min_ratio, max_ratio]
+        # over layers 1.., with a half-scale prior for layer 0
+        assert num_layer >= 3, \
+            "min_sizes must be given explicitly for < 3 feature maps"
+        min_sizes, max_sizes = [], []
+        step = int(math.floor((max_ratio - min_ratio) / (num_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+    if steps:
+        step_w = step_h = steps
+
+    mbox_locs, mbox_confs, box_results, var_results = [], [], [], []
+    for i, inp in enumerate(inputs):
+        min_size = min_sizes[i]
+        max_size = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if aspect_ratios is not None else [1.0]
+        if not isinstance(ar, (list, tuple)):
+            ar = [ar]
+        step = [step_w[i] if step_w else 0.0,
+                step_h[i] if step_h else 0.0]
+        box, var = prior_box(inp, image, min_size, max_size, list(ar),
+                             variance, flip, clip, step, offset)
+        H, W, P = box.shape[0], box.shape[1], box.shape[2]
+        box_results.append(nn.reshape(box, shape=[H * W * P, 4],
+                                      inplace=False))
+        var_results.append(nn.reshape(var, shape=[H * W * P, 4],
+                                      inplace=False))
+
+        loc = nn.conv2d(input=inp, num_filters=P * 4,
+                        filter_size=kernel_size, padding=pad, stride=stride)
+        loc = nn.transpose(loc, perm=[0, 2, 3, 1])       # NHWC
+        mbox_locs.append(nn.reshape(loc, shape=[0, H * W * P, 4],
+                                    inplace=False))
+
+        conf = nn.conv2d(input=inp, num_filters=P * num_classes,
+                         filter_size=kernel_size, padding=pad,
+                         stride=stride)
+        conf = nn.transpose(conf, perm=[0, 2, 3, 1])
+        mbox_confs.append(nn.reshape(
+            conf, shape=[0, H * W * P, num_classes], inplace=False))
+
+    if num_layer == 1:
+        return mbox_locs[0], mbox_confs[0], box_results[0], var_results[0]
+    return (tensor_layers.concat(mbox_locs, axis=1),
+            tensor_layers.concat(mbox_confs, axis=1),
+            tensor_layers.concat(box_results, axis=0),
+            tensor_layers.concat(var_results, axis=0))
 
 
 def box_coder(prior_box, prior_box_var, target_box,
@@ -64,8 +134,21 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
     if max_sizes:
         attrs["max_sizes"] = [float(s) for s in (
             max_sizes if isinstance(max_sizes, (list, tuple)) else [max_sizes])]
-    box = helper.create_tmp_variable(dtype=input.dtype)
-    var = helper.create_tmp_variable(dtype=input.dtype)
+        assert len(attrs["max_sizes"]) == len(attrs["min_sizes"]), (
+            "max_sizes must pair 1:1 with min_sizes (one sqrt(min*max) "
+            "square prior per min_size)")
+    # static [H, W, P, 4] shape so heads (multi_box_head) can size their
+    # conv branches; P mirrors the kernel's prior-count rule: per min_size,
+    # every aspect ratio plus (when max_sizes given) one square prior
+    from ..ops.detection_ops import _expand_aspect_ratios
+
+    shape = None
+    if input.shape is not None and len(input.shape) == 4:
+        n_ar = len(_expand_aspect_ratios(attrs["aspect_ratios"], flip))
+        P = len(attrs["min_sizes"]) * (n_ar + (1 if max_sizes else 0))
+        shape = (input.shape[2], input.shape[3], P, 4)
+    box = helper.create_tmp_variable(dtype=input.dtype, shape=shape)
+    var = helper.create_tmp_variable(dtype=input.dtype, shape=shape)
     helper.append_op("prior_box", {"Input": [input], "Image": [image]},
                      {"Boxes": [box], "Variances": [var]}, attrs)
     box.stop_gradient = True

