@@ -15,6 +15,7 @@ carry tensors between producer/consumer blocks feeding a training loop.
 
 import queue
 import threading
+import time
 
 from .layer_helper import LayerHelper
 from .core.framework import Variable, VarType, default_main_program
@@ -88,6 +89,8 @@ class Channel:
 
     def recv(self, block=True, timeout=None):
         """-> (value, ok). ok=False when the channel is closed and drained."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
         while True:
             try:
                 value, taken = self._q.get(block=False)
@@ -99,10 +102,9 @@ class Channel:
                     return None, False
                 if not block:
                     raise
-                if not self._closed.wait(0.001) and timeout is not None:
-                    timeout -= 0.001
-                    if timeout <= 0:
-                        raise queue.Empty
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise queue.Empty
+                self._closed.wait(0.001)
 
     def can_recv(self):
         return not self._q.empty()

@@ -341,20 +341,30 @@ class Block:
         return v
 
     # -- ops ----------------------------------------------------------------
-    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+    def _new_op(self, type, inputs, outputs, attrs):
         op = Operator(self, type, inputs, outputs, attrs)
+        # compile-time shape contract (reference op_desc.cc InferShape at
+        # desc build): validates inputs and sets output shapes so malformed
+        # programs fail HERE with op context, not mid-jax-trace
+        from . import shape_inference
+
+        shape_inference.infer(op, self)
+        return op
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        op = self._new_op(type, inputs, outputs, attrs)
         self.ops.append(op)
         self.program._mutation += 1
         return op
 
     def prepend_op(self, type, inputs=None, outputs=None, attrs=None):
-        op = Operator(self, type, inputs, outputs, attrs)
+        op = self._new_op(type, inputs, outputs, attrs)
         self.ops.insert(0, op)
         self.program._mutation += 1
         return op
 
     def insert_op(self, index, type, inputs=None, outputs=None, attrs=None):
-        op = Operator(self, type, inputs, outputs, attrs)
+        op = self._new_op(type, inputs, outputs, attrs)
         self.ops.insert(index, op)
         self.program._mutation += 1
         return op

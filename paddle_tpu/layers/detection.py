@@ -12,7 +12,8 @@ from ..layer_helper import LayerHelper
 
 __all__ = ["box_coder", "iou_similarity", "prior_box", "bipartite_match",
            "target_assign", "mine_hard_examples", "ssd_loss",
-           "multiclass_nms", "detection_output", "multi_box_head"]
+           "multiclass_nms", "detection_output", "multi_box_head",
+           "detection_map"]
 
 
 def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
@@ -206,7 +207,8 @@ def mine_hard_examples(cls_loss, match_indices, match_dist,
         {"NegIndices": [neg_indices], "UpdatedMatchIndices": [updated]},
         {"neg_pos_ratio": neg_pos_ratio,
          "neg_dist_threshold": neg_dist_threshold,
-         "mining_type": mining_type},
+         "mining_type": mining_type,
+         "sample_size": sample_size},
     )
     neg_indices.stop_gradient = True
     updated.stop_gradient = True
@@ -244,7 +246,7 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     neg_indices, updated_indices = mine_hard_examples(
         mining_loss, matched_indices, matched_dist,
         neg_pos_ratio=neg_pos_ratio, neg_dist_threshold=neg_overlap,
-        mining_type=mining_type)
+        mining_type=mining_type, sample_size=sample_size)
 
     # 5. final classification targets (positives + mined negatives)
     final_label, conf_w = target_assign(
@@ -307,3 +309,52 @@ def detection_output(loc, scores, prior_box, prior_box_var,
         decoded, scores_t, background_label=background_label,
         score_threshold=score_threshold, nms_top_k=nms_top_k,
         nms_threshold=nms_threshold, keep_top_k=keep_top_k, nms_eta=nms_eta)
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral"):
+    """VOC mAP of a detection batch (reference layers/detection.py:157).
+
+    detect_res: LoD [M,6] rows [label, score, xmin, ymin, xmax, ymax]
+    label: LoD [N,6] rows [label, difficult, box] or [N,5] [label, box]
+    With has_state/input_states/out_states the op chains its
+    (pos_count, true_pos, false_pos) accumulators across batches —
+    the DetectionMAP evaluator wires that loop up.
+    """
+    helper = LayerHelper("detection_map", **locals())
+
+    def _var(dtype):
+        return helper.create_tmp_variable(dtype=dtype, stop_gradient=True)
+
+    map_out = _var("float32")
+    accum_pos_count_out = out_states[0] if out_states else _var("int32")
+    accum_true_pos_out = out_states[1] if out_states else _var("float32")
+    accum_false_pos_out = out_states[2] if out_states else _var("float32")
+
+    inputs = {"Label": [label], "DetectRes": [detect_res]}
+    if has_state is not None:
+        inputs["HasState"] = [has_state]
+    if input_states:
+        inputs["PosCount"] = [input_states[0]]
+        inputs["TruePos"] = [input_states[1]]
+        inputs["FalsePos"] = [input_states[2]]
+    helper.append_op(
+        "detection_map",
+        inputs,
+        {
+            "MAP": [map_out],
+            "AccumPosCount": [accum_pos_count_out],
+            "AccumTruePos": [accum_true_pos_out],
+            "AccumFalsePos": [accum_false_pos_out],
+        },
+        {
+            "overlap_threshold": overlap_threshold,
+            "evaluate_difficult": evaluate_difficult,
+            "ap_type": ap_version,
+            "class_num": class_num,
+            "background_label": background_label,
+        },
+    )
+    return map_out

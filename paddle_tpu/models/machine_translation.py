@@ -27,7 +27,14 @@ def bi_lstm_encoder(input_seq, gate_size):
 
 
 def seq_to_seq_net(embedding_dim, encoder_size, decoder_size,
-                   source_dict_dim, target_dict_dim):
+                   source_dict_dim, target_dict_dim,
+                   max_source_len=32, max_target_len=32):
+    """max_{source,target}_len are STATIC scan bounds for the decoder (they
+    size the padded [B,T,*] buffers XLA compiles). They are enforced, not
+    advisory: attention_lstm_decoder raises on any batch whose sequences
+    exceed the cap (ops/rnn_ops.py _check_cap), so real data longer than
+    the default 32 must pass larger caps here rather than being silently
+    truncated."""
     src_word_idx = fluid.layers.data(
         name="source_sequence", shape=[1], dtype="int64", lod_level=1)
     src_embedding = fluid.layers.embedding(
@@ -56,7 +63,8 @@ def seq_to_seq_net(embedding_dim, encoder_size, decoder_size,
 
     # static scan bounds: wmt14 sequences are <= ~17 tokens with <s>/<e>;
     # without these the kernel falls back to scanning ntokens (sum over the
-    # batch) masked steps — correct but ~batch_size times more work
+    # batch) masked steps — correct but ~batch_size times more work.
+    # Over-cap batches raise inside the op (no silent truncation).
     prediction = fluid.layers.attention_lstm_decoder(
         target_embedding=trg_embedding,
         encoder_vec=encoded_vector,
@@ -64,7 +72,7 @@ def seq_to_seq_net(embedding_dim, encoder_size, decoder_size,
         decoder_boot=decoder_boot,
         decoder_size=decoder_size,
         target_dict_dim=target_dict_dim,
-        max_target_len=32, max_source_len=32)
+        max_target_len=max_target_len, max_source_len=max_source_len)
 
     label = fluid.layers.data(
         name="label_sequence", shape=[1], dtype="int64", lod_level=1)

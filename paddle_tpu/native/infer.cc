@@ -382,7 +382,15 @@ const Tensor& need(Env& s, const std::string& n) {
 Tensor broadcast_like(const Tensor& x, const Tensor& y, int axis) {
   if (y.dims == x.dims) return to_f32(y);
   int xr = (int)x.dims.size(), yr = (int)y.dims.size();
+  // reference trims trailing size-1 dims of Y before aligning
+  // (elementwise_op_function.h get_mid_dims / trim_trailing_singular_dims)
+  while (yr > 1 && y.dims[yr - 1] == 1) --yr;
   if (axis < 0) axis = xr - yr;
+  if (axis < 0 || axis + yr > xr)
+    throw std::runtime_error(
+        "elementwise broadcast: axis " + std::to_string(axis) +
+        " with Y rank " + std::to_string(yr) + " out of range for X rank " +
+        std::to_string(xr));
   Tensor yf_s;
 
   const Tensor& yf = as_f32(y, yf_s);

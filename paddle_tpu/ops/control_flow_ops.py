@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.registry import register_op, SeqTensor
+from ..core.registry import register_op, register_grad_maker, SeqTensor
 from .util import first, many, out
 
 
@@ -278,6 +278,67 @@ def array_to_lod_tensor_op(ctx, ins, attrs):
             datas.append(jnp.stack(rows))
     data = jnp.concatenate(datas, axis=0) if datas else jnp.zeros((0,))
     return out(Out=SeqTensor(data, jnp.asarray(lens, jnp.int32)))
+
+
+@register_op("reorder_lod_tensor_by_rank", lod_aware=True, no_trace=True)
+def reorder_lod_tensor_by_rank_op(ctx, ins, attrs):
+    """Reorder a batch of sequences into rank-table order; when X carries no
+    LoD, reorder its rows (each row = a length-1 sequence). Reference
+    operators/reorder_lod_tensor_by_rank_op.cc:38-66 — host-side
+    OperatorBase there, eager host op here like the rest of the rank-table
+    family. The RankTable may come from a different sequence than X."""
+    import numpy as np
+
+    x = first(ins, "X")
+    order, _ = first(ins, "RankTable")
+    order_np = np.asarray(order)
+    if isinstance(x, SeqTensor):
+        lens = np.asarray(x.lengths)
+        offs = np.zeros(len(lens) + 1, np.int64)
+        offs[1:] = np.cumsum(lens)
+        rows = (np.concatenate(
+            [np.arange(offs[i], offs[i + 1]) for i in order_np])
+            if len(order_np) else np.zeros((0,), np.int64))
+        data = jnp.take(x.data, jnp.asarray(rows, jnp.int32), axis=0)
+        return out(Out=SeqTensor(data,
+                                 jnp.asarray(lens[order_np], jnp.int32)))
+    return out(Out=jnp.take(x, jnp.asarray(order_np, jnp.int32), axis=0))
+
+
+@register_op("reorder_lod_tensor_by_rank_grad", lod_aware=True,
+             no_trace=True)
+def reorder_lod_tensor_by_rank_grad_op(ctx, ins, attrs):
+    """Scatter the gradient back to the original order (the reference grad
+    op restores the pre-sort order via the saved rank table)."""
+    import numpy as np
+
+    g = first(ins, "Out@GRAD")
+    order, _ = first(ins, "RankTable")
+    order_np = np.asarray(order)
+    if isinstance(g, SeqTensor):
+        # sequence i of X landed at rank position p = inv[i]; gather back
+        lens_sorted = np.asarray(g.lengths)
+        offs = np.zeros(len(lens_sorted) + 1, np.int64)
+        offs[1:] = np.cumsum(lens_sorted)
+        pos_of_orig = np.argsort(order_np, kind="stable")
+        rows = (np.concatenate(
+            [np.arange(offs[p], offs[p + 1]) for p in pos_of_orig])
+            if len(order_np) else np.zeros((0,), np.int64))
+        data = jnp.take(g.data, jnp.asarray(rows, jnp.int32), axis=0)
+        return {"X@GRAD": [SeqTensor(
+            data, jnp.asarray(lens_sorted[pos_of_orig], jnp.int32))]}
+    inv = jnp.asarray(np.argsort(order_np, kind="stable"), jnp.int32)
+    return {"X@GRAD": [jnp.take(g, inv, axis=0)]}
+
+
+@register_grad_maker("reorder_lod_tensor_by_rank")
+def reorder_lod_tensor_by_rank_grad_maker(op, gout, gin):
+    return [dict(
+        type="reorder_lod_tensor_by_rank_grad",
+        inputs={"Out@GRAD": gout["Out"], "RankTable": op.input("RankTable")},
+        outputs={"X@GRAD": gin["X"]},
+        attrs=dict(op.attrs),
+    )]
 
 
 @register_op("shrink_rnn_memory", lod_aware=True, no_trace=True)

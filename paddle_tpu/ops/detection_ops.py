@@ -322,12 +322,18 @@ def mine_hard_examples_op(ctx, ins, attrs):
     mdist = np.asarray(mdist) if mdist is not None else None
     ratio = float(attrs.get("neg_pos_ratio", 3.0))
     neg_thresh = float(attrs.get("neg_dist_threshold", 0.5))
+    sample_size = attrs.get("sample_size")
     B, P = match.shape
     neg_rows = []
     lengths = []
     for b in range(B):
         pos = match[b] >= 0
         num_neg = int(min(P - pos.sum(), np.ceil(ratio * pos.sum())))
+        if sample_size is not None:
+            # per-image cap on mined negatives (the reference uses
+            # sample_size as the hard_example budget; under max_negative it
+            # bounds the ratio-derived count instead of being dropped)
+            num_neg = min(num_neg, int(sample_size))
         cand = np.where(~pos if mdist is None
                         else (~pos) & (mdist[b] < neg_thresh))[0]
         order = cand[np.argsort(-cls_loss[b, cand], kind="stable")]
@@ -412,3 +418,221 @@ def multiclass_nms_op(ctx, ins, attrs):
     return out(Out=SeqTensor(
         jnp.asarray(np.asarray(rows, np.float32)),
         jnp.asarray(lengths, jnp.int32)))
+
+
+# ---------------------------------------------------------------------------
+# detection_map: VOC-style mean average precision with cross-batch
+# accumulation. Reference operators/detection_map_op.{cc,h} (CPU-only kernel
+# there; host op here, like the rest of the match/NMS family).
+# ---------------------------------------------------------------------------
+def _dmap_iou(b1, b2):
+    """Jaccard overlap of two [xmin,ymin,xmax,ymax] boxes (detection_map_op.h
+    JaccardOverlap — returns 0 on no overlap, no +1 edge correction)."""
+    if b2[0] > b1[2] or b2[2] < b1[0] or b2[1] > b1[3] or b2[3] < b1[1]:
+        return 0.0
+    ixmin, iymin = max(b1[0], b2[0]), max(b1[1], b2[1])
+    ixmax, iymax = min(b1[2], b2[2]), min(b1[3], b2[3])
+    inter = (ixmax - ixmin) * (iymax - iymin)
+    a1 = (b1[2] - b1[0]) * (b1[3] - b1[1])
+    a2 = (b2[2] - b2[0]) * (b2[3] - b2[1])
+    denom = a1 + a2 - inter
+    return float(inter / denom) if denom > 0 else 0.0
+
+
+def _dmap_average_precision(tp_pairs, fp_pairs, num_pos, ap_type):
+    """AP for one class from accumulated (score, flag) lists
+    (detection_map_op.h GetAccumulation + CalcMAP)."""
+    order = sorted(range(len(tp_pairs)),
+                   key=lambda i: -tp_pairs[i][0])  # stable desc by score
+    tp_sum, fp_sum = [], []
+    t = f = 0
+    for i in order:
+        t += tp_pairs[i][1]
+        f += fp_pairs[i][1]
+        tp_sum.append(t)
+        fp_sum.append(f)
+    precision = [ts / (ts + fs) if ts + fs else 0.0
+                 for ts, fs in zip(tp_sum, fp_sum)]
+    recall = [ts / num_pos for ts in tp_sum]
+    n = len(tp_sum)
+    if ap_type == "11point":
+        max_precisions = [0.0] * 11
+        start_idx = n - 1
+        for j in range(10, -1, -1):
+            for i in range(start_idx, -1, -1):
+                if recall[i] < j / 10.0:
+                    start_idx = i
+                    if j > 0:
+                        max_precisions[j - 1] = max_precisions[j]
+                    break
+                if max_precisions[j] < precision[i]:
+                    max_precisions[j] = precision[i]
+        return sum(max_precisions) / 11.0
+    if ap_type == "integral":
+        ap = 0.0
+        prev_recall = 0.0
+        for i in range(n):
+            if abs(recall[i] - prev_recall) > 1e-6:
+                ap += precision[i] * abs(recall[i] - prev_recall)
+            prev_recall = recall[i]
+        return ap
+    raise ValueError(f"detection_map: unknown ap_type {ap_type!r} "
+                     "(want 'integral' or '11point')")
+
+
+def _dmap_split(seq):
+    """Per-image row ranges of a SeqTensor (or a plain array = one image)."""
+    if isinstance(seq, SeqTensor):
+        data = np.asarray(seq.data)
+        lens = np.asarray(seq.lengths)
+    else:
+        data = np.asarray(seq)
+        lens = np.asarray([data.shape[0]])
+    offs = np.zeros(len(lens) + 1, np.int64)
+    offs[1:] = np.cumsum(lens)
+    return data, [(int(offs[i]), int(offs[i + 1])) for i in range(len(lens))]
+
+
+@register_op("detection_map", lod_aware=True, no_trace=True)
+def detection_map_op(ctx, ins, attrs):
+    """VOC mAP over a batch, optionally chained through accumulator state.
+
+    DetectRes: LoD [M,6] rows [label, score, xmin, ymin, xmax, ymax].
+    Label: LoD [N,6] rows [label, difficult, box] or [N,5] rows [label, box].
+    State (PosCount int32 [C,1]; TruePos/FalsePos LoD [K,2] of (score, flag)
+    with one sequence per class) is folded in when HasState != 0.
+
+    Divergence from the reference, documented: CalcMAP's literal
+    `label_num_pos == background_label` skip (detection_map_op.h:413-424)
+    compares a count against a class id; its practical effect (with the
+    default background_label=0) is skipping zero-count classes seeded from
+    the PosCount state. Implemented here as the evident intent: skip
+    zero-count classes AND the background class itself.
+    """
+    detect = first(ins, "DetectRes")
+    label = first(ins, "Label")
+    has_state = first(ins, "HasState")
+    class_num = int(attrs["class_num"])
+    background_label = int(attrs.get("background_label", 0))
+    overlap_threshold = float(attrs.get("overlap_threshold", 0.3))
+    evaluate_difficult = bool(attrs.get("evaluate_difficult", True))
+    ap_type = str(attrs.get("ap_type", "integral"))
+
+    det_data, det_ranges = _dmap_split(detect)
+    lab_data, lab_ranges = _dmap_split(label)
+    if len(det_ranges) != len(lab_ranges):
+        raise ValueError(
+            f"detection_map: DetectRes batch {len(det_ranges)} != "
+            f"Label batch {len(lab_ranges)}")
+    with_difficult = lab_data.shape[1] == 6
+
+    # per-image per-class ground truths [(box, difficult)] and detections
+    gt_boxes, det_boxes = [], []
+    for s, e in lab_ranges:
+        boxes = {}
+        for r in lab_data[s:e]:
+            cls = int(r[0])
+            if with_difficult:
+                boxes.setdefault(cls, []).append(
+                    (r[2:6].tolist(), bool(abs(float(r[1])) >= 1e-6)))
+            else:
+                boxes.setdefault(cls, []).append((r[1:5].tolist(), False))
+        gt_boxes.append(boxes)
+    for s, e in det_ranges:
+        boxes = {}
+        for r in det_data[s:e]:
+            boxes.setdefault(int(r[0]), []).append(
+                (float(r[1]), r[2:6].tolist()))
+        det_boxes.append(boxes)
+
+    # seed accumulators from state
+    label_pos_count = {}
+    true_pos = {}
+    false_pos = {}
+    state = int(np.asarray(has_state).reshape(-1)[0]) \
+        if has_state is not None else 0
+    pos_count_in = first(ins, "PosCount")
+    if pos_count_in is not None and state:
+        pc = np.asarray(pos_count_in).reshape(-1)
+        for c in range(class_num):
+            label_pos_count[c] = int(pc[c])
+        for slot, dest in (("TruePos", true_pos), ("FalsePos", false_pos)):
+            seq = first(ins, slot)
+            data, ranges = _dmap_split(seq)
+            for c, (s, e) in enumerate(ranges):
+                dest[c] = [(float(data[j, 0]), int(data[j, 1]))
+                           for j in range(s, e)]
+
+    # CalcTrueAndFalsePositive (detection_map_op.h:310-409)
+    for boxes in gt_boxes:
+        for cls, blist in boxes.items():
+            count = len(blist) if evaluate_difficult else \
+                sum(1 for _b, diff in blist if not diff)
+            if count:
+                label_pos_count[cls] = label_pos_count.get(cls, 0) + count
+    for img_gt, img_det in zip(gt_boxes, det_boxes):
+        for cls, preds in img_det.items():
+            if cls not in img_gt:
+                for score, _box in preds:
+                    true_pos.setdefault(cls, []).append((score, 0))
+                    false_pos.setdefault(cls, []).append((score, 1))
+                continue
+            gts = img_gt[cls]
+            visited = [False] * len(gts)
+            for score, box in sorted(preds, key=lambda p: -p[0]):
+                clipped = [min(max(v, 0.0), 1.0) for v in box]
+                best, best_j = -1.0, 0
+                for j, (gbox, _diff) in enumerate(gts):
+                    ov = _dmap_iou(clipped, gbox)
+                    if ov > best:
+                        best, best_j = ov, j
+                if best > overlap_threshold:
+                    if evaluate_difficult or not gts[best_j][1]:
+                        if not visited[best_j]:
+                            true_pos.setdefault(cls, []).append((score, 1))
+                            false_pos.setdefault(cls, []).append((score, 0))
+                            visited[best_j] = True
+                        else:
+                            true_pos.setdefault(cls, []).append((score, 0))
+                            false_pos.setdefault(cls, []).append((score, 1))
+                else:
+                    true_pos.setdefault(cls, []).append((score, 0))
+                    false_pos.setdefault(cls, []).append((score, 1))
+
+    # CalcMAP
+    m_ap, counted = 0.0, 0
+    for cls, num_pos in label_pos_count.items():
+        if num_pos == 0 or cls == background_label or cls not in true_pos:
+            continue
+        m_ap += _dmap_average_precision(
+            true_pos[cls], false_pos[cls], num_pos, ap_type)
+        counted += 1
+    m_ap = m_ap / counted if counted else 0.0
+
+    # pack accumulators (GetOutputPos): one sequence per class
+    pos_out = np.zeros((class_num, 1), np.int32)
+    for c, n in label_pos_count.items():
+        if 0 <= c < class_num:
+            pos_out[c, 0] = n
+
+    def pack(d):
+        rows, lens = [], []
+        for c in range(class_num):
+            pairs = d.get(c, [])
+            lens.append(len(pairs))
+            rows.extend(pairs)
+        data = np.asarray(rows, np.float32).reshape(len(rows), 2) \
+            if rows else np.zeros((0, 2), np.float32)
+        return SeqTensor(jnp.asarray(data), jnp.asarray(lens, jnp.int32))
+
+    return out(
+        MAP=jnp.asarray([m_ap], jnp.float32),
+        AccumPosCount=jnp.asarray(pos_out),
+        AccumTruePos=pack(true_pos),
+        AccumFalsePos=pack(false_pos),
+    )
+
+
+set_stop_gradient_outputs(
+    "detection_map",
+    ["MAP", "AccumPosCount", "AccumTruePos", "AccumFalsePos"])

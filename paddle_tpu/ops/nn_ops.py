@@ -391,6 +391,45 @@ def dropout_grad_op(ctx, ins, attrs):
     return {"X@GRAD": [g * mask]}
 
 
+@register_op("random_crop")
+def random_crop_op(ctx, ins, attrs):
+    """Per-instance random crop of the trailing dims to attrs["shape"].
+
+    Fluid op semantics (this reference snapshot predates
+    random_crop_op.cc; the layer facade shipped ahead of the kernel in r2):
+    X has shape [batch..., d_1..d_k]; each batch instance is cropped to
+    `shape` (= [c_1..c_k], one entry per trailing dim) at an independent
+    uniform offset. seed attr 0 means "use the executor rng stream"; a fixed
+    seed gives a deterministic crop schedule. Offsets live in lax
+    dynamic_slice starts, so the op traces with static shapes (MXU-safe)."""
+    x = first(ins, "X")
+    crop = tuple(int(s) for s in attrs["shape"])
+    k = len(crop)
+    if not (1 <= k <= x.ndim):
+        raise ValueError(
+            f"random_crop: shape {crop} incompatible with input rank "
+            f"{x.ndim}")
+    for i in range(k):
+        if crop[i] > x.shape[x.ndim - k + i]:
+            raise ValueError(
+                f"random_crop: crop dim {crop[i]} exceeds input dim "
+                f"{x.shape[x.ndim - k + i]}")
+    batch_shape = tuple(x.shape[:x.ndim - k])
+    seed = int(attrs.get("seed", 0) or 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
+    n = int(np.prod(batch_shape)) if batch_shape else 1
+    xf = x.reshape((n,) + tuple(x.shape[x.ndim - k:]))
+    maxoff = jnp.asarray(
+        [x.shape[x.ndim - k + i] - crop[i] for i in range(k)], jnp.int32)
+    offs = jax.random.randint(key, (n, k), 0, maxoff + 1, dtype=jnp.int32)
+
+    def crop_one(xi, oi):
+        return lax.dynamic_slice(xi, [oi[i] for i in range(k)], crop)
+
+    y = jax.vmap(crop_one)(xf, offs)
+    return out(Out=y.reshape(batch_shape + crop))
+
+
 @register_grad_maker("dropout")
 def dropout_grad_maker(op, gout, gin):
     return [

@@ -9,7 +9,14 @@ hardware. Set BEFORE any jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set (NOT setdefault): the ambient env may carry JAX_PLATFORMS=<tpu
+# plugin>. Note the env var alone is NOT sufficient on the bench host: its
+# sitecustomize imports jax at interpreter startup (before this conftest)
+# and force-sets the jax_platforms config, which outranks the env var. The
+# config.update below is what actually wins — it sticks because XLA
+# backends are not yet initialized at conftest time (once they are, the
+# update is a no-op; that is the r2 MULTICHIP failure mode).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -20,8 +27,6 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
-# The axon TPU plugin overrides JAX_PLATFORMS from the environment; force the
-# host platform explicitly so tests always run on the virtual 8-CPU mesh.
 jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402

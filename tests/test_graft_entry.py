@@ -64,6 +64,36 @@ def test_dryrun_multichip_after_jax_initialized():
     assert "post-init-ok" in r.stdout, r.stdout
 
 
+def test_dryrun_multichip_ambient_env_unscrubbed():
+    """r2 failure mode: the scrubbed-env tests above can never see what the
+    bench host sees. Run the driver invocation with the environment EXACTLY
+    as inherited — whatever JAX*/XLA*/LIBTPU* vars this process carries."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g\ng.dryrun_multichip(8)\n"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, f"stderr:\n{r.stderr}\nstdout:\n{r.stdout}"
+    assert "loss=" in r.stdout, r.stdout
+
+
+def test_dryrun_multichip_noncpu_jax_platforms():
+    """JAX_PLATFORMS set to a non-cpu value (the bench host's axon plugin
+    case) must not leak into the dryrun: the re-exec child hard-sets cpu.
+    This fails if the in-process provisioning path ever comes back — the
+    parent would then try to initialize the bogus platform and die."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "definitely_not_a_platform"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g\ng.dryrun_multichip(8)\n"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, f"stderr:\n{r.stderr}\nstdout:\n{r.stdout}"
+    assert "loss=" in r.stdout, r.stdout
+
+
 @pytest.mark.slow
 def test_entry_fresh_process():
     """entry() must return (fn, example_args) with fn jittable — the

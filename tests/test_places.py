@@ -1,0 +1,102 @@
+"""Place-pinned execution (r2 VERDICT missing #1 / weak #2).
+
+Reference parity: the Executor runs ops ON the given Place
+(paddle/fluid/framework/executor.cc:133, platform/place.h:25-49). Here the
+Place must pin every trace/eager dispatch to a concrete jax.Device — it is
+not cosmetic metadata.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.places import (
+    CPUPlace, TPUPlace, CUDAPlace, jax_device_for)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _device_of(arr):
+    devs = arr.devices()
+    assert len(devs) == 1, devs
+    return next(iter(devs))
+
+
+def test_jax_device_for_cpu_place_resolves_host_platform():
+    d = jax_device_for(CPUPlace())
+    assert d.platform == "cpu"
+
+
+def test_jax_device_for_device_id():
+    # On the forced 8-device host mesh there is no accelerator, so
+    # TPUPlace(i) falls back to default devices indexed by device_id.
+    devs = jax.devices()
+    assert jax_device_for(TPUPlace(3)) == devs[3 % len(devs)]
+    assert jax_device_for(CUDAPlace(5)) == devs[5 % len(devs)]
+
+
+def _tiny_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=4)
+    return main, startup, y
+
+
+@pytest.mark.parametrize("idx", [0, 3])
+def test_executor_pins_state_and_fetches_to_place_device(idx):
+    """Executor(TPUPlace(i)) must commit startup state and step outputs to
+    device i of the mesh — observable on the virtual 8-CPU mesh."""
+    main, startup, y = _tiny_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(TPUPlace(idx))
+        exe.run(startup)
+        want = jax.devices()[idx]
+        # startup-created parameter
+        pnames = [n for n, v in main.global_block().vars.items()
+                  if getattr(v, "persistable", False)]
+        assert pnames
+        for n in pnames:
+            buf = scope.find_var(n)
+            if hasattr(buf, "devices"):
+                assert _device_of(buf) == want, (n, _device_of(buf))
+        outs = exe.run(main,
+                       feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[y], return_numpy=False)
+        assert _device_of(outs[0]) == want
+
+
+def test_executor_cpu_place_backed_by_cpu_even_with_accelerator_default():
+    """The r2 failure: on a host whose default backend is a TPU plugin,
+    Executor(CPUPlace()) executed on the TPU. Run with the environment
+    exactly as inherited (NO scrubbing) in a fresh interpreter — on the
+    bench host that env carries the accelerator plugin."""
+    code = (
+        "import numpy as np\n"
+        "import paddle_tpu as fluid\n"
+        "main, startup = fluid.Program(), fluid.Program()\n"
+        "with fluid.program_guard(main, startup):\n"
+        "    x = fluid.layers.data(name='x', shape=[4], dtype='float32')\n"
+        "    y = fluid.layers.fc(input=x, size=4)\n"
+        "scope = fluid.Scope()\n"
+        "with fluid.scope_guard(scope):\n"
+        "    exe = fluid.Executor(fluid.CPUPlace())\n"
+        "    exe.run(startup)\n"
+        "    outs = exe.run(main, feed={'x': np.ones((2, 4), 'float32')},\n"
+        "                   fetch_list=[y], return_numpy=False)\n"
+        "d = next(iter(outs[0].devices()))\n"
+        "assert d.platform == 'cpu', f'got {d.platform}'\n"
+        "print('cpu-place-ok', d.platform)\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stderr:\n{r.stderr}\nstdout:\n{r.stdout}"
+    assert "cpu-place-ok" in r.stdout

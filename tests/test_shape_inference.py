@@ -1,0 +1,154 @@
+"""Compile-time InferShape contracts (r2 VERDICT missing #5).
+
+Reference: framework/shape_inference.h + per-op InferShape checked at
+OpDesc build time (op_desc.cc). A malformed program must raise at
+append_op with op context — not deep inside a jax trace.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program, program_guard
+from paddle_tpu.core.shape_inference import ShapeError
+
+
+def test_malformed_conv_raises_at_build_time():
+    """Channel mismatch between input and a hand-built filter must raise
+    when the op is appended, naming the op."""
+    with program_guard(Program(), Program()):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                dtype="float32")
+        w = fluid.layers.create_parameter(shape=[16, 4, 3, 3],
+                                          dtype="float32")
+        block = fluid.default_main_program().global_block()
+        out = block.create_var(name="convout", dtype="float32")
+        with pytest.raises(ShapeError, match="conv2d"):
+            block.append_op(
+                "conv2d", {"Input": [img], "Filter": [w]},
+                {"Output": [out]},
+                {"strides": [1, 1], "paddings": [0, 0],
+                 "dilations": [1, 1], "groups": 1})
+
+
+def test_conv_output_shape_is_set_by_contract():
+    with program_guard(Program(), Program()):
+        img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                dtype="float32")
+        y = fluid.layers.conv2d(img, num_filters=8, filter_size=5,
+                                stride=2, padding=2)
+    assert tuple(y.shape) == (-1, 8, 16, 16), y.shape
+
+
+def test_empty_conv_output_raises():
+    """Kernel bigger than (padded) input -> empty output, caught at build."""
+    with program_guard(Program(), Program()):
+        img = fluid.layers.data(name="img", shape=[3, 4, 4],
+                                dtype="float32")
+        with pytest.raises(ShapeError, match="conv2d"):
+            fluid.layers.conv2d(img, num_filters=8, filter_size=9)
+
+
+def test_mul_inner_dim_mismatch_raises():
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[7], dtype="float32")
+        w = fluid.layers.create_parameter(shape=[8, 4], dtype="float32")
+        block = fluid.default_main_program().global_block()
+        out = block.create_var(name="mulout", dtype="float32")
+        with pytest.raises(ShapeError, match="mul"):
+            block.append_op("mul", {"X": [x], "Y": [w]}, {"Out": [out]},
+                            {"x_num_col_dims": 1, "y_num_col_dims": 1})
+
+
+def test_elementwise_shape_mismatch_raises():
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[4, 5], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[4, 6], dtype="float32")
+        with pytest.raises(ShapeError, match="elementwise_add"):
+            fluid.layers.elementwise_add(x, y)
+
+
+def test_elementwise_mid_axis_broadcast_ok():
+    """Reference axis rule: Y [C] aligns at axis=1 of X [N,C,H,W]."""
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[8, 4, 4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[8], append_batch_size=False,
+                              dtype="float32")
+        out = fluid.layers.elementwise_add(x, y, axis=1)
+    assert tuple(out.shape) == (-1, 8, 4, 4)
+
+
+def test_reshape_numel_mismatch_raises():
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32",
+                              append_batch_size=False)
+        with pytest.raises(ShapeError, match="reshape"):
+            fluid.layers.reshape(x, shape=[4], inplace=False)
+
+
+def test_reshape_infers_minus_one():
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[2, 6], dtype="float32",
+                              append_batch_size=False)
+        y = fluid.layers.reshape(x, shape=[3, -1], inplace=False)
+    assert tuple(y.shape) == (3, 4)
+
+
+def test_concat_mismatched_nonaxis_dim_raises():
+    # a (-1,3,4) vs c (-1,3,5) concat on axis=1: dim 2 (4 vs 5) must match
+    with program_guard(Program(), Program()):
+        a = fluid.layers.data(name="a", shape=[3, 4], dtype="float32")
+        c = fluid.layers.data(name="c", shape=[3, 5], dtype="float32")
+        with pytest.raises(ShapeError, match="concat"):
+            fluid.layers.concat([a, c], axis=1)
+
+
+def test_concat_sums_axis_dim():
+    with program_guard(Program(), Program()):
+        a = fluid.layers.data(name="a", shape=[3, 4], dtype="float32")
+        c = fluid.layers.data(name="c", shape=[5, 4], dtype="float32")
+        out = fluid.layers.concat([a, c], axis=1)
+    assert tuple(out.shape) == (-1, 8, 4)
+
+
+def test_cross_entropy_label_shape_raises():
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[10], dtype="float32")
+        lab = fluid.layers.data(name="lab", shape=[3], dtype="int64")
+        with pytest.raises(ShapeError, match="cross_entropy"):
+            fluid.layers.cross_entropy(input=x, label=lab)
+
+
+def test_lookup_table_ids_last_dim_raises():
+    with program_guard(Program(), Program()):
+        ids = fluid.layers.data(name="ids", shape=[4], dtype="int64")
+        with pytest.raises(ShapeError, match="lookup_table"):
+            fluid.layers.embedding(input=ids, size=[100, 16])
+
+
+def test_transpose_bad_perm_raises():
+    # hand-built op (the layer pre-validates; the contract must catch a
+    # transpiler- or user-built desc too)
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[2, 3], dtype="float32")
+        block = fluid.default_main_program().global_block()
+        out = block.create_var(name="tout", dtype="float32")
+        with pytest.raises(ShapeError, match="transpose"):
+            block.append_op("transpose", {"X": [x]}, {"Out": [out]},
+                            {"axis": [1, 0]})
+
+
+def test_contract_error_names_op_and_inputs():
+    """The raised message must carry op context (type + input names) the
+    way the reference enforce does."""
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[4, 5], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[4, 6], dtype="float32")
+        try:
+            fluid.layers.elementwise_add(x, y)
+        except ShapeError as e:
+            msg = str(e)
+            assert "elementwise_add" in msg
+            assert "x" in msg and "y" in msg
+        else:
+            pytest.fail("expected ShapeError")
