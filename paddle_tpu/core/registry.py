@@ -88,11 +88,29 @@ class OpDef:
 _registry = {}
 
 
-def register_op(type, lod_aware=False, no_trace=False):
-    """Decorator: register the forward (or explicit grad) kernel for `type`."""
+def register_op(type, lod_aware=False, no_trace=False, override=False):
+    """Decorator: register the forward (or explicit grad) kernel for `type`.
+
+    A second registration for the same type raises unless override=True —
+    a silent shadow once let two drifting copies of the reduce family
+    coexist, with import order picking the winner.
+    """
 
     def deco(fn):
-        _registry[type] = OpDef(type, fn, lod_aware=lod_aware, no_trace=no_trace)
+        prev = _registry.get(type)
+        if prev is not None and prev.fn is not None and not override:
+            raise ValueError(
+                f"kernel for op type {type!r} registered twice "
+                f"(existing: {prev.fn.__module__}.{prev.fn.__qualname__}, "
+                f"new: {fn.__module__}.{fn.__qualname__}); pass "
+                f"override=True if shadowing is intended")
+        new = OpDef(type, fn, lod_aware=lod_aware, no_trace=no_trace)
+        if prev is not None:  # keep grad makers etc. attached to the stub
+            prev.fn = new.fn
+            prev.lod_aware = new.lod_aware
+            prev.no_trace = new.no_trace
+        else:
+            _registry[type] = new
         return fn
 
     return deco

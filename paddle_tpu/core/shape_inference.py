@@ -205,7 +205,9 @@ def _mul(ctx):
     xnc = ctx.attr("x_num_col_dims", 1)
     ync = ctx.attr("y_num_col_dims", 1)
     ctx.enforce(len(x) > xnc, f"X rank {len(x)} <= x_num_col_dims {xnc}")
-    ctx.enforce(len(y) >= ync, f"Y rank {len(y)} < y_num_col_dims {ync}")
+    # reference mul_op InferShape: Y rank strictly greater than
+    # y_num_col_dims, else y[ync:] is empty and Out silently loses cols
+    ctx.enforce(len(y) > ync, f"Y rank {len(y)} <= y_num_col_dims {ync}")
     kx = _numel(x[xnc:])
     ky = _numel(y[:ync])
     if kx is not None and ky is not None:
@@ -235,7 +237,16 @@ def _matmul(ctx):
                 f"contraction mismatch: X{x} (tx={tx}) K={xs[-1]} vs "
                 f"Y{y} (ty={ty}) K={ys[-2]}")
     batch = xs[:-2] if len(xs) >= len(ys) else ys[:-2]
-    ctx.set_output_dim("Out", tuple(batch) + (xs[-2], ys[-1]))
+    # mirror the kernel (math_ops.py matmul_op) and reference
+    # matmul_op.cc:306-317: the dim inserted to pad a 1-D operand is
+    # squeezed back out of Out (-2 slot for X, -1 slot for Y)
+    tail = [xs[-2], ys[-1]]
+    if len(y) == 1:
+        tail.pop(1)
+    if len(x) == 1:
+        tail.pop(0)
+    out = list(batch) + tail
+    ctx.set_output_dim("Out", tuple(out) if out else (1,))
 
 
 @register_infer_shape(
@@ -251,20 +262,28 @@ def _elementwise(ctx):
             axis = -1
         ctx.enforce(len(y) <= len(x),
                     f"Y rank {len(y)} > X rank {len(x)}")
+        # Reference broadcast rule (elementwise_op_function.h): Y is aligned
+        # at `axis` (default: trailing); trailing size-1 dims of Y are
+        # trimmed before alignment, and any size-1 Y dim broadcasts against
+        # the corresponding X dim — a scalar/all-ones Y matches any X.
+        # The runtime kernel (util.bcast_y_to_x + numpy broadcasting) accepts
+        # exactly this, so the contract must too.
         if len(y) == len(x):
-            ctx.enforce(_shapes_match(x, y),
-                        f"same-rank elementwise shape mismatch: X{x} vs "
-                        f"Y{y}")
+            for i in range(len(x)):
+                ctx.enforce(_dim_match(x[i], y[i]) or y[i] == 1,
+                            f"same-rank elementwise shape mismatch: X{x} vs "
+                            f"Y{y}")
         else:
+            # default axis aligns the UNtrimmed Y rank (reference computes
+            # axis before trim_trailing_singular_dims)
+            a = axis if axis >= 0 else len(x) - len(y)
             yr = len(y)
-            # reference rule: trailing size-1 dims of Y are squeezed
             while yr > 1 and y[yr - 1] == 1:
                 yr -= 1
-            a = axis if axis >= 0 else len(x) - yr
             ctx.enforce(0 <= a <= len(x) - yr,
                         f"axis {axis} out of range for X{x} vs Y{y}")
             for i in range(yr):
-                ctx.enforce(_dim_match(x[a + i], y[i]),
+                ctx.enforce(_dim_match(x[a + i], y[i]) or y[i] == 1,
                             f"dim {a + i}: X{x} vs Y{y} (axis={axis})")
     if x is not None:
         ctx.set_output_dim("Out", x)
@@ -425,7 +444,7 @@ def _lookup_table(ctx):
         return
     ctx.enforce(len(w) == 2, f"W must be 2-D [V, D], got {w}")
     if ids is not None:
-        ctx.enforce(ids[-1] == 1, f"Ids{ids} last dim must be 1")
+        ctx.enforce(_dim_match(ids[-1], 1), f"Ids{ids} last dim must be 1")
         ctx.set_output_dim("Out", tuple(ids[:-1]) + (w[1],))
 
 

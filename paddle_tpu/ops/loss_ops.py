@@ -36,30 +36,8 @@ def _as_seq(x):
     return SeqTensor(x, jnp.asarray([x.shape[0]], jnp.int32))
 
 
-# ---------------------------------------------------------------------------
-# Reductions (reference operators/reduce_op.cc: reduce_sum/mean/max/min/prod)
-# ---------------------------------------------------------------------------
-def _reduce_kernel(name, fn):
-    @register_op(name)
-    def _k(ctx, ins, attrs, _fn=fn):
-        x = first(ins, "X")
-        if attrs.get("reduce_all", False):
-            axes = None
-        else:
-            dim = attrs.get("dim", 0)
-            axes = tuple(d % x.ndim for d in
-                         (dim if isinstance(dim, (list, tuple)) else [dim]))
-        return out(Out=_fn(x, axes, attrs.get("keep_dim", False)))
-
-    return _k
-
-
-_reduce_kernel("reduce_sum", lambda x, a, k: jnp.sum(x, axis=a, keepdims=k))
-_reduce_kernel("reduce_mean", lambda x, a, k: jnp.mean(x, axis=a, keepdims=k))
-_reduce_kernel("reduce_max", lambda x, a, k: jnp.max(x, axis=a, keepdims=k))
-_reduce_kernel("reduce_min", lambda x, a, k: jnp.min(x, axis=a, keepdims=k))
-_reduce_kernel("reduce_prod", lambda x, a, k: jnp.prod(x, axis=a, keepdims=k))
-
+# The reduce_* family lives in math_ops.py (single registration — a second
+# copy here once shadowed it by import order and the two drifted).
 
 # ---------------------------------------------------------------------------
 # CTC

@@ -58,6 +58,8 @@ def matmul_op(ctx, ins, attrs):
         o = o.squeeze(-2)
     if squeeze_y:
         o = o.squeeze(-1)
+    if o.ndim == 0:
+        o = o.reshape(1)  # fluid has no 0-d tensors (matmul_op.cc)
     if alpha != 1.0:
         o = o * alpha
     return out(Out=o)
@@ -128,19 +130,23 @@ def scale_op(ctx, ins, attrs):
 
 @register_op("mean")
 def mean_op(ctx, ins, attrs):
-    return out(Out=jnp.mean(first(ins, "X")))
+    # fluid has no 0-d tensors: mean_op.cc infers Out as {1}
+    return out(Out=jnp.mean(first(ins, "X")).reshape(1))
 
 
 def _reduce(fn):
     def kernel(ctx, ins, attrs):
         x = first(ins, "X")
-        dim = attrs.get("dim", None)
+        dim = attrs.get("dim", 0)  # fluid reduce_op.cc: dim defaults to {0}
         keep = attrs.get("keep_dim", False)
-        if attrs.get("reduce_all", False) or dim is None:
+        if attrs.get("reduce_all", False):
             axis = None
         else:
             axis = tuple(d % x.ndim for d in (dim if isinstance(dim, (list, tuple)) else [dim]))
-        return out(Out=fn(x, axis=axis, keepdims=keep))
+        o = fn(x, axis=axis, keepdims=keep)
+        # fluid has no 0-d tensors: a full reduce infers Out as {1}
+        # (reduce_op.cc), and the shape contract says the same
+        return out(Out=o.reshape(1) if o.ndim == 0 else o)
 
     return kernel
 

@@ -39,11 +39,20 @@ def astype(x, dtype):
 
 def bcast_y_to_x(x, y, axis):
     """Reference elementwise broadcast: Y's shape matches a contiguous
-    subsequence of X's dims starting at `axis` (default: trailing align).
-    operators/elementwise_op_function.h semantics."""
+    subsequence of X's dims starting at `axis` (default: trailing align,
+    computed on the untrimmed Y rank); Y's trailing size-1 dims are trimmed
+    before alignment. operators/elementwise_op_function.h semantics
+    (trim_trailing_singular_dims + get_mid_dims)."""
     if x.ndim == y.ndim:
         return y
     if axis == -1 or axis is None:
         axis = x.ndim - y.ndim
-    new_shape = [1] * axis + list(y.shape) + [1] * (x.ndim - axis - y.ndim)
+    shape = list(y.shape)
+    while len(shape) > 1 and shape[-1] == 1:
+        shape.pop()
+    if axis + len(shape) > x.ndim:
+        raise ValueError(
+            f"elementwise Y{tuple(y.shape)} does not fit X{tuple(x.shape)} "
+            f"at axis={axis}")
+    new_shape = [1] * axis + shape + [1] * (x.ndim - axis - len(shape))
     return y.reshape(new_shape)

@@ -1,0 +1,402 @@
+"""Contract-vs-kernel consistency fuzz (r3 VERDICT weak #5 / task 1).
+
+The r3 regression class: a shape contract (core/shape_inference.py) stricter
+than the kernel it guards rejected a valid program at build time
+(elementwise_mul vs GradClipByGlobalNorm's scalar broadcast). Reference
+parity: the reference's InferShape and kernel share one shape function
+(operators/*_op.cc InferShape + the kernel's own launch math), so they can't
+drift. Here they are separate code, so this fuzz pins them together:
+
+For each fuzzed op, random shape cases are judged twice —
+  * contract verdict: append_op on a Program (runs shape_inference.infer)
+  * kernel verdict: the registered kernel run under jax.eval_shape
+and the verdicts must agree:
+  * contract ACCEPTS  => kernel must accept AND the kernel's output shape
+    must equal the shape the contract set (the authoritative metadata).
+  * case marked "invalid" => contract must REJECT (the kernel usually
+    rejects too, but e.g. numpy broadcasting can be laxer than the
+    reference semantics the contract encodes — kernel laxness is harmless,
+    contract strictness is the bug).
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core import registry, shape_inference
+from paddle_tpu.core.executor_core import OpContext
+from paddle_tpu.core.framework import Program
+from paddle_tpu.core.shape_inference import ShapeError
+
+rng = random.Random(20260730)
+
+
+def rdims(rank, lo=1, hi=5):
+    return tuple(rng.randint(lo, hi) for _ in range(rank))
+
+
+# ---------------------------------------------------------------------------
+# case generators: each yields (inputs, attrs, expect)
+#   inputs: {slot: shape | [shape, ...]}   (all float32 unless in INT_SLOTS)
+#   expect: "valid" | "invalid" | "any"
+#     "any" = only the forward implication is checked (contract accepts =>
+#     kernel accepts); used where the kernel is legitimately laxer.
+# ---------------------------------------------------------------------------
+INT_SLOTS = {("lookup_table", "Ids"): ("int64", lambda shape, vocab: None)}
+
+
+def gen_elementwise():
+    for _ in range(12):
+        x = rdims(rng.randint(1, 4))
+        yield {"X": x, "Y": x}, {"axis": -1}, "valid"
+    for _ in range(10):
+        x = rdims(rng.randint(2, 4))
+        yr = rng.randint(1, len(x))
+        a = rng.randint(0, len(x) - yr)
+        y = x[a:a + yr]
+        axis = a if rng.random() < 0.5 or a + yr != len(x) else -1
+        yield {"X": x, "Y": y}, {"axis": axis}, "valid"
+    # scalar / all-ones Y broadcasts anywhere (the r3 regression case)
+    for _ in range(6):
+        x = rdims(rng.randint(1, 4))
+        yield {"X": x, "Y": (1,)}, {"axis": -1}, "valid"
+    for _ in range(6):
+        x = rdims(rng.randint(2, 4), lo=2)
+        yr = rng.randint(1, len(x) - 1)
+        y = tuple(d + 1 for d in x[len(x) - yr:])  # mismatched, no 1s
+        yield {"X": x, "Y": y}, {"axis": -1}, "invalid"
+    # trailing size-1 trim: Y = x-slice + (1,) aligned at axis
+    for _ in range(4):
+        x = rdims(3, lo=2)
+        yield {"X": x, "Y": (x[1], 1)}, {"axis": 1}, "valid"
+    # explicit axis where the UNtrimmed Y rank overruns X but the trimmed
+    # rank fits (the r4 review case: trim must happen in both judges)
+    for _ in range(4):
+        x = rdims(3, lo=2)
+        yield {"X": x, "Y": (x[2], 1)}, {"axis": 2}, "valid"
+        yield {"X": x, "Y": (1, 1)}, {"axis": 2}, "valid"
+    # explicit axis past the end even after trimming
+    for _ in range(3):
+        x = rdims(3, lo=2)
+        yield {"X": x, "Y": (x[2],)}, {"axis": 3}, "invalid"
+        yield {"X": x, "Y": (1, 1)}, {"axis": 3}, "invalid"
+
+
+def gen_matmul():
+    for _ in range(8):
+        m, k, n = rdims(3, hi=6)
+        yield {"X": (m, k), "Y": (k, n)}, {}, "valid"
+    for _ in range(4):
+        b, m, k, n = rdims(4, hi=4)
+        yield {"X": (b, m, k), "Y": (b, k, n)}, {}, "valid"
+    for _ in range(4):
+        m, k, n = rdims(3, hi=6)
+        yield ({"X": (k, m), "Y": (k, n)},
+               {"transpose_X": True}, "valid")
+    # 1-D operands (ADVICE r3 #1: Out must squeeze the padded dim)
+    for _ in range(4):
+        k, n = rdims(2, hi=6)
+        yield {"X": (k,), "Y": (k, n)}, {}, "valid"
+        yield {"X": (n, k), "Y": (k,)}, {}, "valid"
+        yield {"X": (k,), "Y": (k,)}, {}, "valid"
+    for _ in range(5):
+        m, k, n = rdims(3, lo=2, hi=6)
+        yield {"X": (m, k), "Y": (k + 1, n)}, {}, "invalid"
+
+
+def gen_mul():
+    for _ in range(8):
+        m, k, n = rdims(3, hi=6)
+        yield {"X": (m, k), "Y": (k, n)},  \
+            {"x_num_col_dims": 1, "y_num_col_dims": 1}, "valid"
+    for _ in range(4):
+        a, b, c, n = rdims(4, hi=4)
+        yield {"X": (a, b, c), "Y": (b * c, n)}, \
+            {"x_num_col_dims": 1, "y_num_col_dims": 1}, "valid"
+    for _ in range(4):
+        m, k, n = rdims(3, lo=2, hi=6)
+        yield {"X": (m, k), "Y": (k + 1, n)}, \
+            {"x_num_col_dims": 1, "y_num_col_dims": 1}, "invalid"
+
+
+def gen_reshape():
+    for _ in range(8):
+        x = rdims(rng.randint(1, 4))
+        perm = list(x)
+        rng.shuffle(perm)
+        yield {"X": x}, {"shape": perm}, "valid"
+    for _ in range(4):
+        x = rdims(2, lo=2)
+        yield {"X": x}, {"shape": [-1, x[1]]}, "valid"
+        yield {"X": x}, {"shape": [0, -1]}, "valid"
+    for _ in range(4):
+        x = rdims(2, lo=2, hi=5)
+        n = x[0] * x[1]
+        yield {"X": x}, {"shape": [n + 1]}, "invalid"
+
+
+def gen_transpose():
+    for _ in range(8):
+        x = rdims(rng.randint(2, 4))
+        perm = list(range(len(x)))
+        rng.shuffle(perm)
+        yield {"X": x}, {"axis": perm}, "valid"
+    yield {"X": (2, 3)}, {"axis": [0, 0]}, "invalid"
+    yield {"X": (2, 3, 4)}, {"axis": [0, 1]}, "invalid"
+
+
+def gen_concat():
+    for _ in range(8):
+        r = rng.randint(1, 3)
+        base = rdims(r)
+        axis = rng.randint(0, r - 1)
+        shapes = []
+        for _ in range(rng.randint(2, 4)):
+            s = list(base)
+            s[axis] = rng.randint(1, 5)
+            shapes.append(tuple(s))
+        yield {"X": shapes}, {"axis": axis}, "valid"
+    s = [(2, 3), (2, 4)]
+    yield {"X": s}, {"axis": 0}, "invalid"
+
+
+def gen_split():
+    for _ in range(6):
+        r = rng.randint(1, 3)
+        x = list(rdims(r))
+        axis = rng.randint(0, r - 1)
+        num = rng.randint(2, 4)
+        x[axis] = num * rng.randint(1, 3)
+        yield ({"X": tuple(x)},
+               {"axis": axis, "num": num, "_n_out": num}, "valid")
+    for _ in range(4):
+        r = rng.randint(1, 3)
+        x = list(rdims(r))
+        axis = rng.randint(0, r - 1)
+        parts = [rng.randint(1, 3) for _ in range(rng.randint(2, 3))]
+        x[axis] = sum(parts)
+        yield ({"X": tuple(x)},
+               {"axis": axis, "sections": parts, "_n_out": len(parts)},
+               "valid")
+    yield {"X": (5, 2)}, {"axis": 0, "num": 2, "_n_out": 2}, "invalid"
+    yield ({"X": (5, 2)},
+           {"axis": 0, "sections": [2, 2], "_n_out": 2}, "invalid")
+
+
+def gen_reduce():
+    for _ in range(10):
+        x = rdims(rng.randint(1, 4))
+        d = rng.randint(-len(x), len(x) - 1)
+        keep = rng.random() < 0.5
+        yield {"X": x}, {"dim": d, "keep_dim": keep}, "valid"
+    yield {"X": (2, 3)}, {"dim": 5}, "invalid"
+    yield {"X": (2, 3)}, {"reduce_all": True}, "valid"
+
+
+def gen_conv2d():
+    for _ in range(6):
+        n, ci, co = rng.randint(1, 3), rng.randint(1, 4), rng.randint(1, 4)
+        k = rng.randint(1, 3)
+        hw = rng.randint(k, k + 6)
+        s, p = rng.randint(1, 2), rng.randint(0, 1)
+        yield ({"Input": (n, ci, hw, hw), "Filter": (co, ci, k, k)},
+               {"strides": [s, s], "paddings": [p, p],
+                "dilations": [1, 1], "groups": 1}, "valid")
+    yield ({"Input": (1, 3, 8, 8), "Filter": (4, 2, 3, 3)},
+           {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+            "groups": 1}, "invalid")
+
+
+def gen_pool2d():
+    for _ in range(6):
+        n, c = rng.randint(1, 3), rng.randint(1, 4)
+        k = rng.randint(1, 3)
+        hw = rng.randint(k, k + 6)
+        yield ({"X": (n, c, hw, hw)},
+               {"ksize": [k, k], "strides": [k, k], "paddings": [0, 0],
+                "pooling_type": "max"}, "valid")
+    yield ({"X": (1, 2, 4, 4)},
+           {"global_pooling": True, "ksize": [1, 1],
+            "pooling_type": "avg"}, "valid")
+
+
+def gen_softmax():
+    for _ in range(4):
+        yield {"X": rdims(2, hi=6)}, {}, "valid"
+
+
+def gen_sum():
+    for _ in range(5):
+        x = rdims(rng.randint(1, 3))
+        yield {"X": [x] * rng.randint(1, 3)}, {}, "valid"
+    yield {"X": [(2, 3), (3, 2)]}, {}, "invalid"
+
+
+def gen_top_k():
+    for _ in range(5):
+        x = rdims(2, lo=2, hi=8)
+        yield {"X": x}, {"k": rng.randint(1, x[-1])}, "valid"
+    yield {"X": (2, 3)}, {"k": 4}, "invalid"
+
+
+def gen_cross_entropy():
+    for _ in range(4):
+        n, c = rng.randint(2, 5), rng.randint(2, 5)
+        yield {"X": (n, c), "Label": (n, 1)}, {}, "any"
+    yield {"X": (4, 3), "Label": (5, 1)}, {}, "invalid"
+
+
+FUZZ = {
+    "elementwise_add": gen_elementwise,
+    "elementwise_mul": gen_elementwise,
+    "elementwise_sub": gen_elementwise,
+    "elementwise_div": gen_elementwise,
+    "elementwise_max": gen_elementwise,
+    "matmul": gen_matmul,
+    "mul": gen_mul,
+    "reshape": gen_reshape,
+    "transpose": gen_transpose,
+    "concat": gen_concat,
+    "split": gen_split,
+    "reduce_sum": gen_reduce,
+    "reduce_mean": gen_reduce,
+    "reduce_max": gen_reduce,
+    "conv2d": gen_conv2d,
+    "pool2d": gen_pool2d,
+    "softmax": gen_softmax,
+    "sum": gen_sum,
+    "top_k": gen_top_k,
+    "cross_entropy": gen_cross_entropy,
+}
+
+
+# ---------------------------------------------------------------------------
+# the two verdicts
+# ---------------------------------------------------------------------------
+def _slot_entries(inputs):
+    """{slot: shape | [shape,...]} -> [(slot, idx, shape)]"""
+    out = []
+    for slot, v in inputs.items():
+        shapes = v if isinstance(v, list) else [v]
+        for i, s in enumerate(shapes):
+            out.append((slot, i, tuple(s)))
+    return out
+
+
+def _out_slots(op_type, attrs):
+    n = attrs.get("_n_out", 1)
+    if op_type == "cross_entropy":
+        return {"Y": 1}
+    if op_type == "top_k":
+        return {"Out": 1, "Indices": 1}
+    if op_type == "split":
+        return {"Out": n}
+    if op_type == "conv2d":
+        return {"Output": 1}
+    return {"Out": 1}
+
+
+def contract_verdict(op_type, inputs, attrs):
+    """Append the op to a fresh Program; return (accepted, out_shapes)."""
+    prog = Program()
+    block = prog.global_block()
+    in_map = {}
+    for slot, i, shape in _slot_entries(inputs):
+        name = f"{slot.lower()}_{i}"
+        dt = "int64" if (op_type, slot) in INT_SLOTS else "float32"
+        block.create_var(name=name, shape=shape, dtype=dt)
+        in_map.setdefault(slot, []).append(name)
+    out_map = {}
+    for slot, n in _out_slots(op_type, attrs).items():
+        names = []
+        for i in range(n):
+            nm = f"out_{slot.lower()}_{i}"
+            block.create_var(name=nm, shape=None, dtype="float32")
+            names.append(nm)
+        out_map[slot] = names
+    clean = {k: v for k, v in attrs.items() if not k.startswith("_")}
+    try:
+        block.append_op(type=op_type, inputs=in_map, outputs=out_map,
+                        attrs=clean)
+    except ShapeError:
+        return False, None
+    shapes = {}
+    for slot, names in out_map.items():
+        shapes[slot] = [tuple(block.vars[n].shape)
+                        if block.vars[n].shape is not None else None
+                        for n in names]
+    return True, shapes
+
+
+def kernel_verdict(op_type, inputs, attrs):
+    """Run the registered kernel under jax.eval_shape; return
+    (accepted, out_shapes)."""
+    op_def = registry.get_op_def(op_type)
+    clean = {k: v for k, v in attrs.items() if not k.startswith("_")}
+    ins = {}
+    for slot, i, shape in _slot_entries(inputs):
+        dt = jnp.int64 if (op_type, slot) in INT_SLOTS else jnp.float32
+        ins.setdefault(slot, []).append(
+            jax.ShapeDtypeStruct(shape, dt))
+
+    def run(ins):
+        ctx = OpContext(rng=jax.random.PRNGKey(0))
+        return op_def.fn(ctx, ins, clean)
+
+    try:
+        outs = jax.eval_shape(run, ins)
+    except Exception as e:  # noqa: BLE001 — any kernel failure = reject
+        if isinstance(e, (jax.errors.TracerArrayConversionError,
+                          jax.errors.ConcretizationTypeError)):
+            # kernel needs concrete values: run it eagerly on tiny data
+            return _kernel_verdict_concrete(op_def, ins, clean)
+        return False, None
+    shapes = {s: [tuple(v.shape) if v is not None else None for v in vs]
+              for s, vs in outs.items()}
+    return True, shapes
+
+
+def _kernel_verdict_concrete(op_def, ins_struct, attrs):
+    conc = {}
+    for slot, vals in ins_struct.items():
+        conc[slot] = [jnp.ones(v.shape, v.dtype) for v in vals]
+    try:
+        ctx = OpContext(rng=jax.random.PRNGKey(0))
+        outs = op_def.fn(ctx, conc, attrs)
+    except Exception:  # noqa: BLE001
+        return False, None
+    shapes = {s: [tuple(v.shape) if v is not None else None for v in vs]
+              for s, vs in outs.items()}
+    return True, shapes
+
+
+@pytest.mark.parametrize("op_type", sorted(FUZZ))
+def test_contract_matches_kernel(op_type):
+    gen = FUZZ[op_type]
+    rng.seed(hash(op_type) & 0xFFFF)
+    for inputs, attrs, expect in gen():
+        c_ok, c_shapes = contract_verdict(op_type, inputs, attrs)
+        case = f"{op_type} inputs={inputs} attrs={attrs}"
+        if expect == "invalid":
+            assert not c_ok, f"contract ACCEPTED invalid case: {case}"
+            continue
+        if expect == "valid":
+            assert c_ok, f"contract REJECTED valid case: {case}"
+        if not c_ok:
+            continue
+        k_ok, k_shapes = kernel_verdict(op_type, inputs, attrs)
+        assert k_ok, (
+            f"contract accepted but KERNEL rejected (contract too lax or "
+            f"kernel bug): {case}")
+        for slot, cs in c_shapes.items():
+            ks = k_shapes.get(slot)
+            assert ks is not None, f"{case}: kernel emitted no {slot}"
+            for i, (a, b) in enumerate(zip(cs, ks)):
+                if a is None:
+                    continue
+                assert a == b, (
+                    f"{case}: {slot}[{i}] contract says {a}, kernel "
+                    f"produced {b}")

@@ -51,16 +51,19 @@ def test_mnist():
     assert losses[0] < 10
 
 
+@pytest.mark.slow
 def test_resnet_cifar():
     losses = run_model("resnet", batch_size=4, iters=2)
     assert losses[0] < 20
 
 
+@pytest.mark.slow
 def test_stacked_dynamic_lstm():
     losses = run_model("stacked_dynamic_lstm", batch_size=4, iters=2)
     assert abs(losses[0] - np.log(2)) < 1.0
 
 
+@pytest.mark.slow
 def test_machine_translation():
     losses = run_model("machine_translation", batch_size=4, iters=2)
     # init loss ~= log(30000)

@@ -72,6 +72,7 @@ def test_executor_pins_state_and_fetches_to_place_device(idx):
         assert _device_of(outs[0]) == want
 
 
+@pytest.mark.slow
 def test_executor_cpu_place_backed_by_cpu_even_with_accelerator_default():
     """The r2 failure: on a host whose default backend is a TPU plugin,
     Executor(CPUPlace()) executed on the TPU. Run with the environment
