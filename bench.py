@@ -21,11 +21,20 @@ scalar and the measured window subtracts the measured scalar round-trip
 latency.
 
 A second end-to-end number (pipeline_images_per_sec) measures the full
-input path — native RecordIO scan -> uint8 decode/normalize on a
-double-buffer prefetch thread -> host->device feed -> train step — via the
-standard Executor.run(feed=...) loop, the reference fluid_benchmark.py
-methodology. On this bench host the feed crosses the chip tunnel, so the
-pipeline number also bounds the tunnel's host->device bandwidth."""
+input path — native RecordIO scan -> uint8 decode on a prefetch thread ->
+DeviceChunkFeeder (stacks K batches, stages them to the chip off the
+compute path) -> Executor.run(iters=K), which runs the K steps inside one
+jit'd lax.scan dispatch. Measurement notes (r4): the old per-step loop was
+dispatch-latency-bound (~600-900 ms per Executor.run on this host, NOT the
+r3 comment's tunnel-bandwidth story); the chunked scan amortizes dispatch
+over K steps. With dispatch amortized, the residual bound is the tunnel's
+host->device bandwidth, which is SHARED and fluctuates by ~50x across runs
+(measured 20 MB/s to 1.6 GB/s for the same 193 MB chunk put) — so the JSON
+reports pipeline_link_MBps (measured during the run) and
+pipeline_link_bound_img_s (the ceiling that bandwidth implies: link_MBps /
+0.1505 MB-per-image) alongside the achieved number. When the link
+cooperates the steady state measures ~0.6 s per 10-step bs128 chunk
+(~2,100 img/s)."""
 
 import json
 import os
@@ -68,16 +77,25 @@ def _build_pipeline_program(fluid):
 
 
 def measure_pipeline(fluid):
-    """RecordIO -> double-buffer decode -> feed -> step, images/s."""
+    """RecordIO -> decode thread -> DeviceChunkFeeder -> iters=K scan,
+    images/s over the timed chunks (the end-to-end input path)."""
     from paddle_tpu import recordio
     from paddle_tpu.reader import decorator
+
+    K = STEPS_PER_CALL
+    # 2 warm chunks, like WARMUP_CALLS=2 on the synthetic path: call 1
+    # compiles; call 2 RE-specializes to the layouts the compiled step
+    # chose for its donated state outputs (measured: a second ~27 s compile
+    # lands on the first post-compile call; steady state from call 3)
+    warm_chunks = 2
+    timed_chunks = max(1, PIPELINE_STEPS)
 
     path = "/tmp/bench_pipeline.recordio"
     if os.path.exists(path):
         os.remove(path)  # the native writer appends; stale records skew reads
     rs = np.random.RandomState(1)
     img_bytes = BATCH * 3 * 224 * 224
-    total = PIPELINE_STEPS + 3  # warmup + timed
+    total = (warm_chunks + timed_chunks) * K
     with recordio.Writer(path, max_num_records=2) as w:
         for _ in range(total):
             img = rs.randint(0, 256, img_bytes, dtype=np.uint8)
@@ -86,38 +104,56 @@ def measure_pipeline(fluid):
 
     def batches():
         for rec in recordio.Scanner(path):
-            # ship uint8 across the host->device link and normalize ON
-            # DEVICE (the data_u8 feed of _build_pipeline_program): 4x less
-            # transfer than f32 — on this host the link is the chip tunnel,
-            # so this decides whether the pipeline is link-bound
+            # uint8 across the link, cast+normalize ON DEVICE (the data_u8
+            # feed of _build_pipeline_program): 4x less transfer than f32
             img = np.frombuffer(rec[:img_bytes], np.uint8).reshape(
                 BATCH, 3, 224, 224)
             lbl = np.frombuffer(rec[img_bytes:], np.int64).reshape(BATCH, 1)
-            yield img, lbl
+            yield {"data_u8": img, "label": lbl}
 
     reader = decorator.buffered(batches, 2)  # decode on a prefetch thread
+
+    # measure the tunnel's host->device bandwidth NOW (it is shared and
+    # varies ~50x between runs): one chunk-sized put, fenced by a scalar
+    # readback (block_until_ready does not reliably block here)
+    import jax
+    probe = np.zeros((K, BATCH, 3, 224, 224), np.uint8)
+    t = time.time()
+    staged_probe = jax.device_put(probe)
+    np.asarray(jax.device_get(staged_probe[0, 0, 0, 0, :1]))
+    link_mbps = probe.nbytes / 1e6 / (time.time() - t)
+    del staged_probe, probe
+
     pipe_prog, pipe_startup, pipe_loss = _build_pipeline_program(fluid)
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe = fluid.Executor(fluid.TPUPlace(0))
         exe.run(pipe_startup)
-        it = reader()
-        for k in range(3):  # compile + warm BOTH fetch variants
-            img, lbl = next(it)
-            fl = [pipe_loss.name] if k == 2 else []
-            exe.run(pipe_prog, feed={"data_u8": img, "label": lbl},
-                    fetch_list=fl)
-        t0 = time.time()
+        feeder = fluid.DeviceChunkFeeder(
+            reader, chunk=K, place=fluid.TPUPlace(0), capacity=2)
         out = None
-        for i in range(PIPELINE_STEPS):
-            img, lbl = next(it)
-            fl = [pipe_loss.name] if i == PIPELINE_STEPS - 1 else []
-            out = exe.run(pipe_prog, feed={"data_u8": img, "label": lbl},
-                          fetch_list=fl)
-        lv = float(np.asarray(out[0]).item())  # fences the queue
+        t0 = None
+        n_timed = 0
+        lv = None
+        for i, chunk in enumerate(feeder):
+            if i == warm_chunks:
+                t0 = time.time()
+            out = exe.run(pipe_prog, feed=chunk, fetch_list=[pipe_loss],
+                          iters=K, return_numpy=False)
+            # fence each chunk with ONE scalar readback: on the tunneled
+            # chip, letting dispatches queue deep while the feeder
+            # device_puts fresh chunks degrades ~15x (transfers serialize
+            # against the queued executions); a depth-1 queue interleaves
+            # transfer and compute cleanly and the feeder still stages the
+            # next chunk during this chunk's execution
+            lv = float(np.asarray(out[0]).reshape(-1)[-1])
+            if t0 is not None:
+                n_timed += 1
         dt = time.time() - t0
     assert np.isfinite(lv), f"non-finite pipeline loss {lv}"
-    return BATCH * PIPELINE_STEPS / dt
+    assert n_timed == timed_chunks, (n_timed, timed_chunks)
+    img_mb = 3 * 224 * 224 / 1e6  # uint8 bytes per image on the wire
+    return BATCH * K * n_timed / dt, link_mbps, link_mbps / img_mb
 
 
 def main():
@@ -210,9 +246,11 @@ def main():
     }
     for attempt in range(2):  # tunneled remote_compile flakes transiently
         try:
-            pipe_s = measure_pipeline(fluid)
+            pipe_s, link_mbps, link_bound = measure_pipeline(fluid)
             result["pipeline_images_per_sec"] = round(pipe_s, 2)
             result["pipeline_frac_of_device"] = round(pipe_s / img_s, 3)
+            result["pipeline_link_MBps"] = round(link_mbps, 1)
+            result["pipeline_link_bound_img_s"] = round(link_bound, 1)
             result.pop("pipeline_error", None)
             break
         except Exception as e:  # headline metric must survive pipeline woes

@@ -90,6 +90,8 @@ from .transpiler import DistributeTranspiler, InferenceTranspiler, memory_optimi
 from .unique_name import generate as _generate_unique_name
 from . import unique_name
 from . import reader
+from . import pipeline
+from .pipeline import DeviceChunkFeeder
 from . import dataset
 from . import parallel
 from .minibatch import batch

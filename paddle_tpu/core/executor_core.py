@@ -225,6 +225,38 @@ def compile_step_fn(step, donate_state=True):
     return jax.jit(step, donate_argnums=(0,) if donate_state else ())
 
 
+def build_multi_step_fn(step, iters):
+    """Wrap a step function in a lax.scan over `iters` pre-stacked feeds.
+
+    One XLA dispatch then covers `iters` training steps — the host-loop
+    dispatch latency (the dominant cost of per-step Executor.run on a
+    tunneled chip: ~600 ms/dispatch measured vs ~50 ms of compute at bs128)
+    is amortized by K. Feeds carry a leading [iters] axis; fetches come back
+    stacked the same way.
+
+    signature: multi(mut_state, const_state, stacked_feeds, rng)
+               -> (stacked_fetches, new_mut)
+    """
+
+    def multi(mut_state, const_state, stacked_feeds, rng):
+        def body(carry, feeds):
+            st, r = carry
+            r, sub = jax.random.split(r)
+            fetches, new_mut = step(st, const_state, feeds, sub)
+            # carry structure must be invariant across iterations: state the
+            # step writes replaces the carried entry; state it only reads
+            # rides through unchanged. Written-but-never-carried names are
+            # rejected up front by the Executor (see run(iters=...)).
+            st = {n: new_mut.get(n, v) for n, v in st.items()}
+            return (st, r), fetches
+
+        (st, _), fetches = jax.lax.scan(
+            body, (mut_state, rng), stacked_feeds, length=iters)
+        return fetches, st
+
+    return multi
+
+
 # ---------------------------------------------------------------------------
 # Feed/fetch conversion helpers
 # ---------------------------------------------------------------------------

@@ -70,6 +70,22 @@ def accelerator_count():
     return len([d for d in jax.devices() if d.platform != "cpu"]) or 0
 
 
+def place_to_str(place):
+    """Serialize a Place for op attrs / JSON IR ('cpu', 'tpu:0', ...)."""
+    if isinstance(place, TPUPlace):
+        return f"tpu:{place.device_id}"
+    return "cpu"
+
+
+def place_from_str(s):
+    if s == "cpu" or not s:
+        return CPUPlace()
+    kind, _, idx = s.partition(":")
+    if kind not in ("tpu", "cuda", "gpu"):
+        raise ValueError(f"unknown place string {s!r}")
+    return TPUPlace(int(idx or 0))
+
+
 def jax_device_for(place):
     """Map a Place to a concrete jax.Device (place.h:25-49 semantics).
 
@@ -77,13 +93,18 @@ def jax_device_for(place):
     NOT by scanning the default backend's device list: when an accelerator
     plugin owns the default backend, ``jax.devices()`` holds no cpu device
     and a scan would silently route CPUPlace to the accelerator (the r2
-    MULTICHIP failure mode)."""
+    MULTICHIP failure mode).
+
+    Places address LOCAL devices (reference place.h: CUDAPlace(i) is the
+    i-th local GPU): under jax.distributed the global device list starts
+    with process 0's devices, so indexing jax.devices() would hand every
+    other process a non-addressable device it cannot execute on."""
     if isinstance(place, CPUPlace) and not isinstance(place, TPUPlace):
         try:
-            return jax.devices("cpu")[0]
+            return jax.local_devices(backend="cpu")[0]
         except RuntimeError:
             # no host platform registered at all; fall back to the default
-            return jax.devices()[0]
-    devs = jax.devices()
+            return jax.local_devices()[0]
+    devs = jax.local_devices()
     accel = [d for d in devs if d.platform != "cpu"] or devs
     return accel[getattr(place, "device_id", 0) % len(accel)]

@@ -35,6 +35,17 @@ def jnp_ravel_first(leaf):
     return jnp.ravel(jnp.asarray(leaf))[:1]
 
 
+def _ensure_addressable(arr):
+    """A jax.Array sharded over a cross-process mesh cannot be read locally;
+    all-gather it to every process first (collective — every process fetches
+    the same names in SPMD lockstep, the reference NCCL2-mode contract)."""
+    if getattr(arr, "is_fully_addressable", True):
+        return arr
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(arr, tiled=True)
+
+
 def as_numpy(tensor):
     if isinstance(tensor, LoDTensor):
         if tensor.lod():
@@ -42,7 +53,7 @@ def as_numpy(tensor):
         return tensor.numpy()
     if isinstance(tensor, (list, tuple)):
         return [as_numpy(t) for t in tensor]
-    return np.asarray(tensor)
+    return np.asarray(_ensure_addressable(tensor))
 
 
 def fetch_var(name, scope=None, return_numpy=True):
@@ -53,7 +64,7 @@ def fetch_var(name, scope=None, return_numpy=True):
     if return_numpy:
         if isinstance(v, SeqTensor):
             return np.asarray(v.data)
-        return np.asarray(v)
+        return np.asarray(_ensure_addressable(v))
     return v
 
 
@@ -89,19 +100,49 @@ class Executor:
         scope=None,
         return_numpy=True,
         use_program_cache=True,
+        iters=None,
     ):
+        """Run the program once — or, with `iters=K`, K steps in ONE device
+        dispatch (a jit'd lax.scan over the step; the TPU-idiomatic host
+        loop). For iters > 1, `feed` is either a list of K per-step feed
+        dicts (stacked and transferred in one device_put) or a single dict
+        whose arrays already carry a leading [K] axis (may be
+        device-resident, e.g. from pipeline.DeviceChunkFeeder). Fetches come
+        back stacked with a leading [K] axis.
+        """
         if program is None:
             program = default_main_program()
         if scope is None:
             scope = global_scope()
-        feed = feed or {}
+        if isinstance(feed, (list, tuple)):
+            if iters is None:
+                iters = len(feed)
+            elif iters != len(feed):
+                raise ValueError(
+                    f"iters={iters} but feed has {len(feed)} step dicts")
+        feed = feed if feed is not None else {}
         fetch_list = fetch_list or []
         fetch_names = [
             v.name if isinstance(v, Variable) else str(v) for v in fetch_list
         ]
 
         with self._device_scope():
-            if _program_has_host_ops(program):
+            if iters is not None:
+                # ANY explicit iters (including 1) means "feeds carry a
+                # leading [K] axis, fetches come back stacked [K, ...]" —
+                # routing K=1 to the plain path would feed the stacked
+                # array with its bogus leading axis straight into the ops
+                if iters < 1:
+                    raise ValueError(f"iters must be >= 1, got {iters}")
+                if _program_has_host_ops(program):
+                    raise ValueError(
+                        "iters requires a fully compilable program "
+                        "(host-side ops like readers/save/print run "
+                        "step-by-step)")
+                outs = self._run_compiled_multi(
+                    program, scope, feed, fetch_names, use_program_cache,
+                    iters)
+            elif _program_has_host_ops(program):
                 outs = self._run_eager(program, scope, feed, fetch_names)
             else:
                 outs = self._run_compiled(
@@ -183,6 +224,91 @@ class Executor:
             executor_core.check_values_finite(
                 list(zip(fetch_names, fetches)) + list(new_mut.items()),
                 context=" after compiled step")
+        return [self._to_host(f) for f in fetches]
+
+    def _stack_feeds(self, program, feed, iters):
+        """list-of-dicts -> one dict of [K, ...] arrays; a dict is trusted to
+        be pre-stacked (leading axis == iters, checked)."""
+        import jax.numpy as jnp
+
+        if isinstance(feed, (list, tuple)):
+            names = set().union(*(f.keys() for f in feed)) if feed else set()
+            stacked = {}
+            for n in names:
+                vals = [f[n] for f in feed]
+                if any(isinstance(v, SeqTensor) for v in vals):
+                    raise ValueError(
+                        f"iters > 1 does not support ragged (LoD) feeds "
+                        f"({n!r}); pad to dense first")
+                arr = np.stack([np.asarray(v) for v in vals], 0)
+                stacked[n] = arr
+            feed = stacked
+        vals = {}
+        gb = program.global_block()
+        for name, value in feed.items():
+            var = gb.vars.get(name)
+            tv = value if hasattr(value, "dtype") else np.asarray(value)
+            if np.shape(tv)[0] != iters:
+                raise ValueError(
+                    f"feed {name!r} leading axis {np.shape(tv)[0]} != "
+                    f"iters {iters} (pre-stacked feeds carry [K, ...])")
+            tv = jnp.asarray(tv)
+            if var is not None and var.dtype is not None \
+                    and str(tv.dtype) != var.dtype:
+                tv = tv.astype(var.dtype)
+            vals[name] = tv
+        return vals
+
+    def _run_compiled_multi(self, program, scope, feed, fetch_names,
+                            use_cache, iters):
+        feed_vals = self._stack_feeds(program, feed, iters)
+        state_names, state_out_names = executor_core.collect_state_names(
+            program, scope)
+        missing = [n for n in state_out_names if not scope.has_var(n)]
+        if missing:
+            raise ValueError(
+                f"iters > 1 needs every written persistable var in scope "
+                f"before the scan (the carry structure is fixed); missing: "
+                f"{missing}. Run the startup program (or one plain "
+                f"exe.run) first.")
+        cache_key = (
+            id(program),
+            program._mutation,
+            tuple(sorted((n, executor_core.spec_of(v))
+                         for n, v in feed_vals.items())),
+            tuple(fetch_names),
+            tuple(state_names),
+            amp.fingerprint(),
+            ("iters", iters),
+        )
+        entry = self._compile_cache.get(cache_key) if use_cache else None
+        if entry is None:
+            step = executor_core.build_step_fn(
+                program, fetch_names, state_out_names)
+            multi = executor_core.build_multi_step_fn(step, iters)
+            compiled = executor_core.compile_step_fn(multi, donate_state=True)
+            entry = (compiled, state_names, state_out_names)
+            if use_cache:
+                self._compile_cache[cache_key] = entry
+        compiled, state_names, state_out_names = entry
+
+        out_set = set(state_out_names)
+        mut_state, const_state = {}, {}
+        for n in state_names:
+            v = scope.find_var(n)
+            if isinstance(v, LoDTensor):
+                v = executor_core.feed_to_tracevalue(v)
+            (mut_state if n in out_set else const_state)[n] = v
+        rng = self._rng_for(program)
+        key = id(program)
+        self._step_counter[key] = self._step_counter.get(key, 0) + iters - 1
+        fetches, new_mut = compiled(mut_state, const_state, feed_vals, rng)
+        for n, v in new_mut.items():
+            scope.set_var(n, v)
+        if flags.get("check_nan_inf"):
+            executor_core.check_values_finite(
+                list(zip(fetch_names, fetches)) + list(new_mut.items()),
+                context=f" after compiled {iters}-step scan")
         return [self._to_host(f) for f in fetches]
 
     def _to_host(self, value):

@@ -216,10 +216,16 @@ def batch(reader, batch_size):
 
 
 def double_buffer(reader, place=None, name=None):
-    """reference create_double_buffer_reader_op.cc:34 — host->device
-    prefetch. On TPU the executor overlaps via async dispatch; this keeps the
-    program-level decorator for parity."""
-    return _decorate_reader("double_buffer_reader", reader, {})
+    """reference create_double_buffer_reader_op.cc:34 — a prefetch thread
+    stages upcoming batches into DEVICE memory (jax.device_put off the
+    compute path). `place` pins the staging device; default: the Executor's
+    place at run time."""
+    attrs = {}
+    if place is not None:
+        from ..core.places import place_to_str
+
+        attrs["place"] = place_to_str(place)
+    return _decorate_reader("double_buffer_reader", reader, attrs)
 
 
 def multi_pass(reader, pass_num):

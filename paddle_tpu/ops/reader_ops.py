@@ -163,16 +163,30 @@ class BatchReader(ReaderBase):
 
 
 class DoubleBufferReader(ReaderBase):
-    """prefetch thread + bounded queue (reference
-    create_double_buffer_reader_op.cc:34-69; the GPU-staging role is played
-    by jax.device_put happening off the consumer's critical path)."""
+    """prefetch thread + bounded queue + DEVICE staging (reference
+    create_double_buffer_reader_op.cc:34-69: the reference's worker copies
+    each buffered batch into a GPU tensor cache; here the worker
+    jax.device_put's every dense slot, so by the time the consumer pops a
+    batch its arrays are already device-resident and the host->device
+    transfer happened off the compute path)."""
 
     _END = object()
 
-    def __init__(self, underlying, capacity=4):
+    def __init__(self, underlying, capacity=4, device=None):
         self._u = underlying
         self._cap = capacity
+        self._dev = device  # jax.Device or None (default device)
         self._start()
+
+    def _stage(self, sample):
+        import jax
+
+        staged = []
+        for arr, lod in sample:
+            if lod is None and hasattr(arr, "shape"):
+                arr = jax.device_put(arr, self._dev)  # None = default device
+            staged.append((arr, lod))
+        return staged
 
     def _start(self):
         # queue + stop flag are captured per-generation: a stale worker that
@@ -185,7 +199,7 @@ class DoubleBufferReader(ReaderBase):
         def work():
             while not stop.is_set():
                 s = u.read_next()
-                q.put(self._END if s is None else s)
+                q.put(self._END if s is None else self._stage(s))
                 if s is None:
                     return
 
@@ -303,7 +317,20 @@ def create_batch_reader_op(ctx, ins, attrs):
 
 @register_op("create_double_buffer_reader", no_trace=True, lod_aware=True)
 def create_double_buffer_reader_op(ctx, ins, attrs):
-    return _store_reader(ctx, lambda: DoubleBufferReader(_underlying(ctx, ins)))
+    def make():
+        dev = None
+        place = attrs.get("place", "")
+        if place:
+            from ..core.places import place_from_str, jax_device_for
+
+            dev = jax_device_for(place_from_str(place))
+        elif ctx.place is not None:
+            from ..core.places import jax_device_for
+
+            dev = jax_device_for(ctx.place)
+        return DoubleBufferReader(_underlying(ctx, ins), device=dev)
+
+    return _store_reader(ctx, make)
 
 
 @register_op("create_multi_pass_reader", no_trace=True, lod_aware=True)

@@ -47,18 +47,35 @@ class ClusterEnv:
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None,
                local_device_ids=None):
-    """jax.distributed.initialize wrapper; safe to call once per process."""
+    """jax.distributed.initialize wrapper; safe to call once per process.
+
+    On the CPU backend a cross-process collectives implementation must be
+    selected BEFORE the backend initializes (gloo plays the NCCL role there;
+    reference nccl_helper.h:92-118 builds NCCLContextMap the same way) —
+    without it each process sees only its own devices and the "cluster"
+    silently degenerates to num_processes independent single-process runs.
+    """
     if _initialized[0]:
         return
     if num_processes is None or num_processes <= 1:
         _initialized[0] = True
         return
+    try:
+        if jax.config.jax_cpu_collectives_implementation is None:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # config knob absent in this jax — TPU-only deployment
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
         local_device_ids=local_device_ids,
     )
+    if jax.process_count() != num_processes:
+        raise RuntimeError(
+            f"distributed bootstrap incomplete: jax.process_count()="
+            f"{jax.process_count()} != num_processes={num_processes} "
+            f"(backend initialized before initialize()?)")
     _initialized[0] = True
 
 

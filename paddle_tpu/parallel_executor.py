@@ -197,6 +197,20 @@ class ParallelExecutor:
             self._compile_cache[cache_key] = entry
         compiled, state_names, state_out_names = entry
 
+        multiproc = any(
+            d.process_index != jax.process_index()
+            for d in self._mesh.devices.flat)
+
+        def place(v, desired):
+            arr = jax.numpy.asarray(v)
+            if multiproc:
+                # a committed single-device array cannot be resharded onto a
+                # cross-process mesh directly; round-trip through the host —
+                # every process holds the identical global value (same-seed
+                # startup), so device_put scatters consistent local shards
+                arr = np.asarray(arr)
+            return jax.device_put(arr, desired)
+
         mut_state, const_state = {}, {}
         out_set = set(state_out_names)
         for n in state_names:
@@ -205,18 +219,21 @@ class ParallelExecutor:
                 v = executor_core.feed_to_tracevalue(v)
             var = program.global_block().vars.get(n)
             annotated = getattr(var, "sharding", None) is not None
+            cur = getattr(v, "sharding", None)
+            on_mesh = isinstance(cur, NamedSharding) and cur.mesh == self._mesh
             if annotated:
                 # the rule must win over whatever placement startup left
                 # behind — but once the array already carries the desired
                 # NamedSharding (every step after the first), re-placing
                 # would all-gather the shards to host each run
                 desired = self._state_sharding(n, v)
-                if getattr(v, "sharding", None) != desired:
-                    v = jax.device_put(jax.numpy.asarray(v), desired)
-            elif not hasattr(v, "sharding") or v.sharding is None \
-                    or not getattr(v, "committed", True):
-                v = jax.device_put(jax.numpy.asarray(v),
-                                   self._state_sharding(n, v))
+                if cur != desired:
+                    v = place(v, desired)
+            elif not on_mesh or not getattr(v, "committed", True):
+                # startup leaves single-device committed arrays; a jit over
+                # the mesh auto-transfers those in-process but REJECTS them
+                # when the mesh spans processes — re-place onto this mesh
+                v = place(v, self._state_sharding(n, v))
             (mut_state if n in out_set else const_state)[n] = v
 
         rng = jax.random.fold_in(jax.random.PRNGKey(program.random_seed), self._step)

@@ -1,0 +1,136 @@
+"""Multi-process collective data parallelism (r3 VERDICT missing #3/task 3).
+
+Reference parity: "NCCL2 mode" — gen_nccl_id_op.cc:31 serves the ncclUniqueId
+from trainer 0, every trainer builds NCCLContextMap(nccl_id, num_trainers,
+trainer_id) (nccl_helper.h:92-118), proven by the in-proc server test
+test_send_nccl_id.cc. TPU adaptation: parallel/distributed.init_from_env
+bootstraps jax.distributed from PADDLE_* env (gloo plays NCCL on the CPU
+backend), after which jax.devices() spans both processes and
+ParallelExecutor's dp mesh aggregates gradients across them.
+
+Each test spawns 2 REAL processes (2 virtual CPU devices each -> a 4-device
+cross-process mesh) that rendezvous on a localhost coordinator.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+from paddle_tpu.parallel import distributed
+
+env = distributed.init_from_env()
+assert distributed.is_initialized()
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()
+
+# --- raw all-reduce across the 2-process mesh (gen_nccl_id/NCCLContextMap
+# parity check): every process must see the sum over ALL 4 devices ---
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+contrib = np.arange(1.0, 5.0, dtype=np.float32).reshape(4, 1)  # per-device
+gx = jax.device_put(contrib, NamedSharding(mesh, P("dp")))
+total = jax.jit(lambda x: jnp.sum(x))(gx)
+assert float(np.asarray(jax.device_get(total))) == 10.0
+
+# --- one DP train step through ParallelExecutor ---
+import paddle_tpu as fluid
+
+main, startup = fluid.Program(), fluid.Program()
+main.random_seed = startup.random_seed = 42
+with fluid.program_guard(main, startup):
+    x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+    y = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square(y - label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+scope = fluid.Scope()
+with fluid.scope_guard(scope):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    w0 = np.array(np.asarray(fluid.fetch_var("fc_0.w_0", scope)))
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                main_program=main)
+    assert pe.device_count == 4, pe.device_count
+    rs = np.random.RandomState(7)  # identical GLOBAL batch on every process
+    feed = {"x": rs.randn(8, 6).astype("float32"),
+            "label": rs.randn(8, 1).astype("float32")}
+    out, = pe.run([loss.name], feed=feed)
+    w1 = np.array(np.asarray(fluid.fetch_var("fc_0.w_0", scope)))
+
+lv = float(np.asarray(out).mean())
+assert np.isfinite(lv), lv
+assert not np.allclose(w0, w1), "SGD step did not update the weight"
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+print(f"RESULT rank={rank} loss={lv:.10f} "
+      f"wsum={float(w1.sum()):.10f} w0sum={float(w0.sum()):.10f}",
+      flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _spawn(rank, port):
+    env = {
+        k: v for k, v in os.environ.items()
+        if not (k.startswith("JAX") or k.startswith("XLA")
+                or k.startswith("LIBTPU") or k.startswith("PADDLE"))
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PADDLE_TRAINING_ROLE"] = "TRAINER"
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS"] = "2"
+    env["PADDLE_COORDINATOR"] = f"127.0.0.1:{port}"
+    return subprocess.Popen(
+        [sys.executable, "-c", WORKER], cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def test_two_process_collective_dp():
+    port = _free_port()
+    procs = [_spawn(r, port) for r in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            o, e = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, o, e))
+    for rc, o, e in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:\n{o}\nstderr:\n{e}"
+    results = {}
+    for rc, o, e in outs:
+        line = [l for l in o.splitlines() if l.startswith("RESULT")][0]
+        kv = dict(tok.split("=") for tok in line.split()[1:])
+        results[int(kv["rank"])] = kv
+    assert set(results) == {0, 1}
+    # grads aggregated over the SAME global batch on a shared mesh: both
+    # ranks land on the identical loss and identical updated parameters
+    assert results[0]["loss"] == results[1]["loss"], results
+    assert results[0]["wsum"] == results[1]["wsum"], results
+    assert results[0]["w0sum"] == results[1]["w0sum"], results
+    assert results[0]["wsum"] != results[0]["w0sum"], results
