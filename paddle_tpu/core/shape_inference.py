@@ -10,9 +10,12 @@ Conventions:
 - a Variable's shape may be None (unknown) — contracts skip checks that
   need it rather than failing;
 - -1 is the dynamic (batch) dim and matches anything;
-- contracts VALIDATE input consistency and SET output var shapes
-  (authoritative: they overwrite layer-side ad-hoc shape math so the two
-  can never drift).
+- contracts VALIDATE input consistency and SET output var shapes.
+  Concrete dims are authoritative (they overwrite layer-side ad-hoc shape
+  math so the two cannot drift); a -1 emitted by a contract means
+  "unknown to the contract" and PRESERVES an existing more-specific
+  layer-side dim (see set_output_dim) — otherwise a -1 written into a
+  parameter's input chain propagates into weight shapes.
 
 Kept free of jax imports so framework.py can use it without pulling the
 backend in at program-build time.
@@ -81,8 +84,17 @@ class InferShapeContext:
         if i >= len(names):
             return
         v = self._var(names[i])
-        if v is not None and dim is not None:
-            v.shape = tuple(int(d) for d in dim)
+        if v is None or dim is None:
+            return
+        new = [int(d) for d in dim]
+        # -1 means "unknown to this contract": keep the layer's existing
+        # more-specific dim rather than clobbering it (a -1 written into a
+        # parameter's input chain otherwise propagates into weight shapes)
+        old = v.shape
+        if old is not None and len(old) == len(new):
+            new = [o if n == -1 and o is not None else n
+                   for n, o in zip(new, old)]
+        v.shape = tuple(new)
 
     def attr(self, name, default=None):
         return self.op.attrs.get(name, default)
@@ -526,3 +538,721 @@ def _split(ctx):
             out = list(x)
             out[axis] = -1 if x[axis] == -1 else x[axis] // num
             ctx.set_output_dim("Out", tuple(out), i)
+
+
+# ---------------------------------------------------------------------------
+# Full-registry coverage (r4): every registered op type carries a contract.
+#
+# Reference parity: EVERY reference op declares InferShape
+# (framework/shape_inference.h:28-60, invoked from op_desc.cc) — malformed
+# programs fail at append_op, never inside a trace. Families whose output
+# rows are data-dependent (LoD/ragged, NMS, CRF) validate what is static and
+# leave the data-dependent dims unset, exactly like the reference's -1 dims.
+# ---------------------------------------------------------------------------
+
+# unary elementwise / same-shape ops not yet in the list above
+register_infer_shape(
+    "cos", "sin", "gelu", "brelu", "hard_shrink", "logsigmoid",
+    "soft_relu", "softshrink", "stanh", "tanh_shrink", "thresholded_relu",
+    "pow", "cumsum", "fill_zeros_like", "assign", "logical_not",
+    "clip_by_norm", "prelu", "increment", "scatter", "reverse",
+    "lod_reset",
+)(_same_shape)
+
+
+@register_infer_shape("label_smooth")
+def _label_smooth(ctx):
+    x = ctx.input_dim("X")
+    d = ctx.input_dim("PriorDist")
+    if x is None:
+        return
+    if d is not None and x[-1] != -1:
+        ctx.enforce(_dim_match(d[-1], x[-1]),
+                    f"PriorDist{d} last dim must match classes {x[-1]}")
+    ctx.set_output_dim("Out", x)
+
+
+def _bcast_out(x, y):
+    """numpy-style broadcast of two shapes; -1 is "unknown" and must stay
+    unknown unless the other side pins it (>1): resolving -1 vs 1 to 1
+    would freeze a wrong static batch into downstream metadata."""
+    r = max(len(x), len(y))
+    xa = (1,) * (r - len(x)) + tuple(x)
+    ya = (1,) * (r - len(y)) + tuple(y)
+    o = []
+    for a, b in zip(xa, ya):
+        if a == -1:
+            o.append(-1 if b in (1, -1) else b)
+        elif b == -1:
+            o.append(-1 if a == 1 else a)
+        elif a == 1:
+            o.append(b)
+        elif b == 1 or b == a:
+            o.append(a)
+        else:
+            return None
+    return tuple(o)
+
+
+@register_infer_shape(
+    "less_than", "less_equal", "greater_than", "greater_equal", "equal",
+    "not_equal", "logical_and", "logical_or", "logical_xor")
+def _compare(ctx):
+    x = ctx.input_dim("X")
+    y = ctx.input_dim("Y")
+    if x is None or y is None:
+        if x is not None:
+            ctx.set_output_dim("Out", x)
+        return
+    o = _bcast_out(x, y)
+    ctx.enforce(o is not None,
+                f"shapes X{x} and Y{y} are not broadcastable")
+    ctx.set_output_dim("Out", o)
+
+
+# -- optimizer family ------------------------------------------------------
+_OPT_STATE_SLOTS = {
+    "sgd": [],
+    "momentum": ["Velocity"],
+    "adam": ["Moment1", "Moment2"],
+    "adamax": ["Moment", "InfNorm"],
+    "adagrad": ["Moment"],
+    "decayed_adagrad": ["Moment"],
+    "adadelta": ["AvgSquaredGrad", "AvgSquaredUpdate"],
+    "rmsprop": ["MeanSquare", "Moment"],
+    "ftrl": ["SquaredAccumulator", "LinearAccumulator"],
+}
+
+
+def _optimizer(ctx):
+    p = ctx.input_dim("Param")
+    g = ctx.input_dim("Grad")
+    if p is not None and g is not None and len(g) > 0:
+        # SelectedRows grads ride through the same slot with row-sliced
+        # shapes; only enforce when ranks agree (dense update)
+        if len(p) == len(g):
+            ctx.enforce(_shapes_match(p, g),
+                        f"Grad{g} must match Param{p}")
+    lr = ctx.input_dim("LearningRate")
+    if lr is not None:
+        ctx.enforce(_numel(lr) in (1, None),
+                    f"LearningRate{lr} must hold one scalar")
+    if p is None:
+        return
+    ctx.set_output_dim("ParamOut", p)
+    for slot in _OPT_STATE_SLOTS[ctx.op.type]:
+        s = ctx.input_dim(slot)
+        if s is not None:
+            ctx.enforce(_shapes_match(s, p), f"{slot}{s} must match Param{p}")
+            ctx.set_output_dim(slot + "Out", s)
+
+
+for _t in _OPT_STATE_SLOTS:
+    register_infer_shape(_t)(_optimizer)
+
+
+# -- conv/interp family ----------------------------------------------------
+@register_infer_shape("conv3d")
+def _conv3d(ctx):
+    x = ctx.input_dim("Input")
+    w = ctx.input_dim("Filter")
+    if x is None or w is None:
+        return
+    ctx.enforce(len(x) == 5, f"Input must be NCDHW 5-D, got {x}")
+    ctx.enforce(len(w) == 5, f"Filter must be [M, C/g, kd, kh, kw], got {w}")
+    groups = ctx.attr("groups", 1) or 1
+    ctx.enforce(_dim_match(x[1], w[1] * groups),
+                f"in_channels {x[1]} != filter_channels {w[1]} * groups "
+                f"{groups}")
+    s = list(ctx.attr("strides", [1, 1, 1]))
+    p = list(ctx.attr("paddings", [0, 0, 0]))
+    d = list(ctx.attr("dilations", [1, 1, 1]))
+    dims = [_conv_out(x[2 + i], w[2 + i], p[i], s[i], d[i])
+            for i in range(3)]
+    ctx.enforce(all(v != 0 and (v > 0 or v == -1) for v in dims),
+                f"empty conv3d output {dims}")
+    ctx.set_output_dim("Output", (x[0], w[0], *dims))
+
+
+@register_infer_shape("conv2d_transpose")
+def _conv2d_transpose(ctx):
+    x = ctx.input_dim("Input")
+    w = ctx.input_dim("Filter")
+    if x is None or w is None:
+        return
+    ctx.enforce(len(x) == 4, f"Input must be NCHW 4-D, got {x}")
+    ctx.enforce(len(w) == 4, f"Filter must be [C, M, kh, kw], got {w}")
+    ctx.enforce(_dim_match(x[1], w[0]),
+                f"in_channels {x[1]} != filter dim0 {w[0]}")
+    s = _pair(ctx.attr("strides", [1, 1]))
+    p = _pair(ctx.attr("paddings", [0, 0]))
+    d = _pair(ctx.attr("dilations", [1, 1]))
+    oh = -1 if x[2] == -1 else \
+        (x[2] - 1) * s[0] - 2 * p[0] + d[0] * (w[2] - 1) + 1
+    ow = -1 if x[3] == -1 else \
+        (x[3] - 1) * s[1] - 2 * p[1] + d[1] * (w[3] - 1) + 1
+    ctx.set_output_dim("Output", (x[0], w[1], oh, ow))
+
+
+@register_infer_shape("bilinear_interp")
+def _bilinear_interp(ctx):
+    x = ctx.input_dim("X")
+    if x is None:
+        return
+    ctx.enforce(len(x) == 4, f"X must be NCHW 4-D, got {x}")
+    oh = ctx.attr("out_h")
+    ow = ctx.attr("out_w")
+    ctx.set_output_dim("Out", (x[0], x[1],
+                               oh if oh else -1, ow if ow else -1))
+
+
+@register_infer_shape("maxout")
+def _maxout(ctx):
+    x = ctx.input_dim("X")
+    if x is None:
+        return
+    ctx.enforce(len(x) == 4, f"X must be NCHW 4-D, got {x}")
+    g = ctx.attr("groups", 1)
+    if x[1] != -1:
+        ctx.enforce(x[1] % g == 0,
+                    f"channels {x[1]} not divisible by groups {g}")
+        ctx.set_output_dim("Out", (x[0], x[1] // g, x[2], x[3]))
+
+
+@register_infer_shape("lrn")
+def _lrn(ctx):
+    x = ctx.input_dim("X")
+    if x is not None:
+        ctx.enforce(len(x) == 4, f"X must be NCHW 4-D, got {x}")
+        ctx.set_output_dim("Out", x)
+        ctx.set_output_dim("MidOut", x)
+
+
+@register_infer_shape("layer_norm")
+def _layer_norm(ctx):
+    x = ctx.input_dim("X")
+    if x is None:
+        return
+    axis = ctx.attr("begin_norm_axis", 1)
+    ctx.enforce(0 < axis < len(x),
+                f"begin_norm_axis {axis} out of range for X{x}")
+    ctx.set_output_dim("Y", x)
+    left = _numel(x[:axis])
+    if left is not None:
+        ctx.set_output_dim("Mean", (left,))
+        ctx.set_output_dim("Variance", (left,))
+
+
+@register_infer_shape("norm")
+def _norm(ctx):
+    x = ctx.input_dim("X")
+    if x is not None:
+        ctx.set_output_dim("Out", x)
+
+
+@register_infer_shape("row_conv")
+def _row_conv(ctx):
+    x = ctx.input_dim("X")
+    w = ctx.input_dim("Filter")
+    if x is not None and w is not None and x[-1] != -1:
+        ctx.enforce(_dim_match(w[-1], x[-1]),
+                    f"Filter{w} last dim must match features {x[-1]}")
+    if x is not None:
+        ctx.set_output_dim("Out", x)
+
+
+# -- losses ----------------------------------------------------------------
+def _pairwise_loss(ctx, x_slot, y_slot, *out_slots):
+    x = ctx.input_dim(x_slot)
+    y = ctx.input_dim(y_slot)
+    if x is not None and y is not None:
+        ctx.enforce(_shapes_match(x, y),
+                    f"{x_slot}{x} and {y_slot}{y} must agree")
+    if x is not None:
+        for slot in out_slots:
+            ctx.set_output_dim(slot, x)
+
+
+@register_infer_shape("square_error_cost")
+def _square_error_cost(ctx):
+    _pairwise_loss(ctx, "X", "Y", "Out")
+
+
+@register_infer_shape("sigmoid_cross_entropy_with_logits")
+def _sigmoid_xent(ctx):
+    _pairwise_loss(ctx, "X", "Label", "Out")
+
+
+@register_infer_shape("hinge_loss")
+def _hinge_loss(ctx):
+    _pairwise_loss(ctx, "Logits", "Labels", "Loss")
+
+
+@register_infer_shape("log_loss")
+def _log_loss(ctx):
+    _pairwise_loss(ctx, "Predicted", "Labels", "Loss")
+
+
+@register_infer_shape("huber_loss")
+def _huber_loss(ctx):
+    _pairwise_loss(ctx, "X", "Y", "Out", "Residual")
+
+
+@register_infer_shape("rank_loss")
+def _rank_loss(ctx):
+    _pairwise_loss(ctx, "Left", "Right", "Out")
+
+
+@register_infer_shape("margin_rank_loss")
+def _margin_rank_loss(ctx):
+    _pairwise_loss(ctx, "X1", "X2", "Out", "Activated")
+
+
+@register_infer_shape("squared_l2_norm")
+def _squared_l2_norm(ctx):
+    ctx.set_output_dim("Out", (1,))
+
+
+@register_infer_shape("squared_l2_distance")
+def _squared_l2_distance(ctx):
+    x = ctx.input_dim("X")
+    y = ctx.input_dim("Y")
+    if x is None:
+        return
+    if y is not None:
+        ctx.enforce(len(x) == len(y), f"X{x} vs Y{y} rank mismatch")
+        ctx.enforce(y[0] == 1 or _dim_match(y[0], x[0]),
+                    f"Y{y} rows must be 1 or match X{x}")
+    ctx.set_output_dim("sub_result", x)
+    ctx.set_output_dim("Out", (x[0], 1))
+
+
+@register_infer_shape("smooth_l1_loss")
+def _smooth_l1_loss(ctx):
+    x = ctx.input_dim("X")
+    y = ctx.input_dim("Y")
+    if x is not None and y is not None:
+        ctx.enforce(_shapes_match(x, y), f"X{x} and Y{y} must agree")
+    if x is not None:
+        ctx.set_output_dim("Diff", x)
+        ctx.set_output_dim("Out", (x[0], 1))
+
+
+@register_infer_shape("cos_sim")
+def _cos_sim(ctx):
+    x = ctx.input_dim("X")
+    y = ctx.input_dim("Y")
+    if x is None:
+        return
+    if y is not None:
+        ctx.enforce(len(x) == len(y), f"X{x} vs Y{y} rank mismatch")
+    ctx.set_output_dim("Out", (x[0], 1))
+    ctx.set_output_dim("XNorm", (x[0], 1))
+    if y is not None:
+        ctx.set_output_dim("YNorm", (y[0], 1))
+
+
+# -- tensor manipulation ---------------------------------------------------
+@register_infer_shape("pad")
+def _pad(ctx):
+    x = ctx.input_dim("X")
+    p = ctx.attr("paddings", [])
+    if x is None:
+        return
+    ctx.enforce(len(p) == 2 * len(x),
+                f"paddings {p} must hold 2 entries per dim of X{x}")
+    ctx.set_output_dim("Out", tuple(
+        -1 if d == -1 else d + p[2 * i] + p[2 * i + 1]
+        for i, d in enumerate(x)))
+
+
+@register_infer_shape("crop")
+def _crop(ctx):
+    x = ctx.input_dim("X")
+    shape = ctx.attr("shape")
+    offsets = ctx.attr("offsets")
+    if x is None or shape is None:
+        return
+    ctx.enforce(len(shape) == len(x),
+                f"crop shape {shape} rank must match X{x}")
+    if offsets is not None:
+        for i, (o, s) in enumerate(zip(offsets, shape)):
+            if x[i] != -1:
+                ctx.enforce(o + s <= x[i],
+                            f"crop dim {i}: offset {o} + size {s} > {x[i]}")
+    ctx.set_output_dim("Out", tuple(shape))
+
+
+@register_infer_shape("gather")
+def _gather(ctx):
+    x = ctx.input_dim("X")
+    idx = ctx.input_dim("Index")
+    if x is None or idx is None:
+        return
+    if len(idx) == 1:
+        ctx.set_output_dim("Out", (idx[0],) + tuple(x[1:]))
+
+
+@register_infer_shape("one_hot")
+def _one_hot(ctx):
+    x = ctx.input_dim("X")
+    depth = ctx.attr("depth")
+    if x is None or depth is None:
+        return
+    n = _numel(x)
+    if n is not None:
+        ctx.set_output_dim("Out", (n, depth))
+
+
+@register_infer_shape("expand")
+def _expand(ctx):
+    x = ctx.input_dim("X")
+    times = ctx.attr("expand_times")
+    if x is None or times is None:
+        return
+    ctx.enforce(len(times) == len(x),
+                f"expand_times {times} rank must match X{x}")
+    ctx.set_output_dim("Out", tuple(
+        -1 if d == -1 else d * t for d, t in zip(x, times)))
+
+
+@register_infer_shape("multiplex")
+def _multiplex(ctx):
+    xs = [s for s in ctx.input_dims("X") if s is not None]
+    for s in xs[1:]:
+        ctx.enforce(_shapes_match(s, xs[0]),
+                    f"multiplex candidates must agree in shape: {xs}")
+    if xs:
+        ctx.set_output_dim("Out", xs[0])
+
+
+@register_infer_shape("shape")
+def _shape(ctx):
+    x = ctx.input_dim("X")
+    if x is not None:
+        ctx.set_output_dim("Out", (len(x),))
+
+
+@register_infer_shape("arg_max", "arg_min")
+def _arg_extreme(ctx):
+    x = ctx.input_dim("X")
+    if x is None:
+        return
+    axis = ctx.attr("axis", -1)
+    ctx.enforce(-len(x) <= axis < len(x),
+                f"axis {axis} out of range for X{x}")
+    axis %= len(x)
+    out = tuple(d for i, d in enumerate(x) if i != axis)
+    ctx.set_output_dim("Out", out if out else (1,))
+
+
+@register_infer_shape("argsort")
+def _argsort(ctx):
+    x = ctx.input_dim("X")
+    if x is not None:
+        ctx.set_output_dim("Out", x)
+        ctx.set_output_dim("Indices", x)
+
+
+@register_infer_shape("gaussian_random", "uniform_random",
+                      "truncated_gaussian_random")
+def _random_fill(ctx):
+    shape = ctx.attr("shape")
+    if shape:
+        ctx.set_output_dim("Out", tuple(int(s) for s in shape))
+
+
+@register_infer_shape("fill_constant_batch_size_like")
+def _fill_batch_like(ctx):
+    ref = ctx.input_dim("Input")
+    shape = list(ctx.attr("shape", []))
+    if not shape:
+        return
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    if ref is not None and in_idx < len(ref) and out_idx < len(shape):
+        shape[out_idx] = ref[in_idx]
+    ctx.set_output_dim("Out", tuple(shape))
+
+
+@register_infer_shape("assign_value")
+def _assign_value(ctx):
+    shape = ctx.attr("shape")
+    if shape:
+        ctx.set_output_dim("Out", tuple(int(s) for s in shape))
+
+
+@register_infer_shape("im2sequence")
+def _im2sequence(ctx):
+    x = ctx.input_dim("X")
+    if x is not None:
+        ctx.enforce(len(x) == 4, f"X must be NCHW 4-D, got {x}")
+
+
+# -- metrics ---------------------------------------------------------------
+@register_infer_shape("accuracy")
+def _accuracy(ctx):
+    idx = ctx.input_dim("Indices")
+    lab = ctx.input_dim("Label")
+    if idx is not None and lab is not None:
+        ctx.enforce(_dim_match(idx[0], lab[0]),
+                    f"Indices{idx} and Label{lab} batch mismatch")
+    ctx.set_output_dim("Accuracy", (1,))
+    ctx.set_output_dim("Correct", (1,))
+    ctx.set_output_dim("Total", (1,))
+
+
+@register_infer_shape("auc")
+def _auc(ctx):
+    ctx.set_output_dim("AUC", (1,))
+
+
+@register_infer_shape("precision_recall")
+def _precision_recall(ctx):
+    ctx.set_output_dim("BatchMetrics", (6,))
+    ctx.set_output_dim("AccumMetrics", (6,))
+
+
+@register_infer_shape("edit_distance")
+def _edit_distance(ctx):
+    ctx.set_output_dim("SequenceNum", (1,))
+
+
+@register_infer_shape("chunk_eval")
+def _chunk_eval(ctx):
+    for slot in ("Precision", "Recall", "F1-Score", "NumInferChunks",
+                 "NumLabelChunks", "NumCorrectChunks"):
+        if ctx.has_output(slot):
+            ctx.set_output_dim(slot, (1,))
+
+
+# -- detection -------------------------------------------------------------
+@register_infer_shape("prior_box")
+def _prior_box(ctx):
+    x = ctx.input_dim("Input")
+    img = ctx.input_dim("Image")
+    if x is not None:
+        ctx.enforce(len(x) == 4, f"Input must be NCHW 4-D, got {x}")
+    if img is not None:
+        ctx.enforce(len(img) == 4, f"Image must be NCHW 4-D, got {img}")
+
+
+@register_infer_shape("iou_similarity")
+def _iou_similarity(ctx):
+    x = ctx.input_dim("X")
+    y = ctx.input_dim("Y")
+    if x is not None:
+        ctx.enforce(x[-1] == 4, f"X{x} last dim must be 4 (boxes)")
+    if y is not None:
+        ctx.enforce(y[-1] == 4, f"Y{y} last dim must be 4 (boxes)")
+    if x is not None and y is not None:
+        ctx.set_output_dim("Out", (x[0], y[0]))
+
+
+@register_infer_shape("box_coder")
+def _box_coder(ctx):
+    pb = ctx.input_dim("PriorBox")
+    if pb is not None:
+        ctx.enforce(pb[-1] == 4, f"PriorBox{pb} last dim must be 4")
+
+
+@register_infer_shape("bipartite_match", "target_assign",
+                      "mine_hard_examples", "multiclass_nms",
+                      "detection_map", "ctc_align")
+def _dynamic_rows(ctx):
+    """Output rows are data-dependent (match counts, kept boxes, aligned
+    tokens) — the reference sets -1 dims here too; nothing static to pin."""
+
+
+# -- sequence (ragged) family ---------------------------------------------
+@register_infer_shape("sequence_softmax", "sequence_erase")
+def _seq_same(ctx):
+    x = ctx.input_dim("X")
+    if x is not None:
+        ctx.set_output_dim("Out", x)
+
+
+@register_infer_shape("sequence_pool")
+def _sequence_pool(ctx):
+    x = ctx.input_dim("X")
+    if x is not None and len(x) >= 2:
+        # rows collapse to one per sequence (count is data-dependent)
+        ctx.set_output_dim("Out", (-1,) + tuple(x[1:]))
+
+
+@register_infer_shape("sequence_conv")
+def _sequence_conv(ctx):
+    x = ctx.input_dim("X")
+    w = ctx.input_dim("Filter")
+    if x is None or w is None:
+        return
+    size = ctx.attr("contextLength", 1)
+    if x[-1] != -1:
+        ctx.enforce(_dim_match(w[0], size * x[-1]),
+                    f"Filter{w} dim0 must be contextLength {size} * "
+                    f"features {x[-1]}")
+    ctx.set_output_dim("Out", (x[0], w[1]))
+
+
+@register_infer_shape("sequence_reshape")
+def _sequence_reshape(ctx):
+    x = ctx.input_dim("X")
+    d = ctx.attr("new_dim")
+    if x is not None and d:
+        ctx.set_output_dim("Out", (-1, d))
+
+
+@register_infer_shape("sequence_expand", "sequence_slice", "sequence_pad",
+                      "sequence_unpad", "sequence_concat")
+def _seq_dynamic(ctx):
+    """Row counts are LoD-dependent; static dims ride through the kernels
+    (SeqTensor), nothing to pin at build time."""
+
+
+# -- RNN family ------------------------------------------------------------
+@register_infer_shape("lstm")
+def _lstm(ctx):
+    x = ctx.input_dim("Input")
+    w = ctx.input_dim("Weight")
+    if w is not None:
+        ctx.enforce(_dim_match(w[1], 4 * w[0]),
+                    f"Weight{w} must be [D, 4D]")
+    if x is not None:
+        ctx.set_output_dim(
+            "Hidden", (x[0], w[0] if w is not None else -1))
+
+
+@register_infer_shape("gru")
+def _gru(ctx):
+    x = ctx.input_dim("Input")
+    w = ctx.input_dim("Weight")
+    if w is not None:
+        ctx.enforce(_dim_match(w[1], 3 * w[0]),
+                    f"Weight{w} must be [D, 3D]")
+    if x is not None:
+        ctx.set_output_dim(
+            "Hidden", (x[0], w[0] if w is not None else -1))
+
+
+@register_infer_shape("lstm_unit")
+def _lstm_unit(ctx):
+    x = ctx.input_dim("X")
+    c = ctx.input_dim("C_prev")
+    if x is not None and c is not None and x[-1] != -1 and c[-1] != -1:
+        ctx.enforce(_dim_match(x[-1], 4 * c[-1]),
+                    f"X{x} features must be 4x C_prev{c} features")
+    if c is not None:
+        ctx.set_output_dim("C", c)
+        ctx.set_output_dim("H", c)
+
+
+@register_infer_shape("gru_unit")
+def _gru_unit(ctx):
+    h = ctx.input_dim("HiddenPrev")
+    if h is not None:
+        ctx.set_output_dim("Hidden", h)
+
+
+@register_infer_shape("attention_lstm_decoder", "attention_lstm_step",
+                      "dynamic_recurrent", "recurrent")
+def _rnn_dynamic(ctx):
+    """Sub-block / ragged outputs; shapes resolve at trace time."""
+
+
+# -- NCE / hierarchical / CRF ---------------------------------------------
+@register_infer_shape("nce")
+def _nce(ctx):
+    x = ctx.input_dim("Input")
+    if x is not None:
+        ctx.set_output_dim("Cost", (x[0], 1))
+
+
+@register_infer_shape("hierarchical_sigmoid")
+def _hsigmoid(ctx):
+    x = ctx.input_dim("X")
+    if x is not None:
+        ctx.set_output_dim("Out", (x[0], 1))
+
+
+@register_infer_shape("linear_chain_crf", "crf_decoding", "warpctc")
+def _crf_dynamic(ctx):
+    """Ragged inputs (SeqTensor); per-sequence outputs are LoD-dependent."""
+
+
+# -- collectives -----------------------------------------------------------
+@register_infer_shape("all_reduce", "broadcast", "collective_permute")
+def _coll_same(ctx):
+    x = ctx.input_dim("X")
+    if x is not None:
+        ctx.set_output_dim("Out", x)
+
+
+@register_infer_shape("all_gather", "reduce_scatter")
+def _coll_resize(ctx):
+    """Output dim0 scales by the mesh axis size, which is a runtime mesh
+    property — left dynamic at build time."""
+
+
+# -- host / side-effect ops ------------------------------------------------
+def _host_noop(ctx):
+    """Side-effect / host ops: no dense output shape semantics at build
+    time (readers hold ReaderHolder state, RPC ops move bytes, channel ops
+    synchronize). The reference registers trivial InferShape for these too
+    (e.g. operators/send_op.cc)."""
+
+
+for _t in (
+    "feed", "fetch", "print", "assert_op", "get_places", "delete_var",
+    "save", "load", "save_combine", "load_combine",
+    "create_recordio_file_reader", "open_files",
+    "create_random_data_generator", "create_shuffle_reader",
+    "create_batch_reader", "create_double_buffer_reader",
+    "create_multi_pass_reader", "read",
+    "send", "recv", "send_vars", "send_barrier", "fetch_barrier",
+    "prefetch", "listen_and_serv",
+    "channel_create", "channel_send", "channel_recv", "channel_close",
+    "go", "select", "while", "conditional_block",
+    "write_to_array", "read_from_array", "lod_tensor_to_array",
+    "array_to_lod_tensor", "lod_rank_table", "shrink_rnn_memory",
+    "reorder_lod_tensor_by_rank", "beam_search", "beam_search_decode",
+    "init_sparse_table", "lookup_sparse_table", "split_ids", "merge_ids",
+    "is_empty", "isfinite",
+):
+    register_infer_shape(_t)(_host_noop)
+
+
+@register_infer_shape("lod_array_length", "max_sequence_len")
+def _len_scalar(ctx):
+    ctx.set_output_dim("Out", (1,))
+
+
+@register_infer_shape("random_crop")
+def _random_crop(ctx):
+    x = ctx.input_dim("X")
+    shape = ctx.attr("shape")
+    if x is None or not shape:
+        return
+    ctx.enforce(len(shape) <= len(x),
+                f"crop shape {shape} rank exceeds X{x}")
+    batch = tuple(x[: len(x) - len(shape)])
+    for i, s in enumerate(shape):
+        d = x[len(x) - len(shape) + i]
+        if d != -1:
+            ctx.enforce(s <= d, f"crop size {s} > input dim {d}")
+    ctx.set_output_dim("Out", batch + tuple(shape))
+
+
+@register_infer_shape("roi_pool")
+def _roi_pool(ctx):
+    x = ctx.input_dim("X")
+    rois = ctx.input_dim("ROIs")
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    if x is not None:
+        ctx.enforce(len(x) == 4, f"X must be NCHW 4-D, got {x}")
+    if rois is not None:
+        ctx.enforce(len(rois) == 2, f"ROIs must be 2-D [R, 4/5], got {rois}")
+    if x is not None and rois is not None:
+        out = (rois[0], x[1], ph, pw)
+        ctx.set_output_dim("Out", out)
+        ctx.set_output_dim("Argmax", out)

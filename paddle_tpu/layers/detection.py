@@ -197,6 +197,14 @@ def target_assign(input, match_indices, negative_indices=None,
 def mine_hard_examples(cls_loss, match_indices, match_dist,
                        neg_pos_ratio=3.0, neg_dist_threshold=0.5,
                        mining_type="max_negative", sample_size=None):
+    """Hard-negative mining (reference mine_hard_examples_op.cc).
+
+    Divergence from the reference: under mining_type="max_negative" the
+    reference IGNORES sample_size (it only budgets hard_example mining);
+    here a given sample_size additionally CAPS the mined negatives per
+    prior set. Porting reference code that sets both mining_type=
+    "max_negative" and sample_size will mine fewer negatives here — leave
+    sample_size=None for strict reference numerics."""
     helper = LayerHelper("mine_hard_examples", **locals())
     neg_indices = helper.create_tmp_variable(dtype="int64", lod_level=1)
     updated = helper.create_tmp_variable(dtype="int64")

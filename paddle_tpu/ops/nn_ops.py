@@ -399,9 +399,13 @@ def random_crop_op(ctx, ins, attrs):
     random_crop_op.cc; the layer facade shipped ahead of the kernel in r2):
     X has shape [batch..., d_1..d_k]; each batch instance is cropped to
     `shape` (= [c_1..c_k], one entry per trailing dim) at an independent
-    uniform offset. seed attr 0 means "use the executor rng stream"; a fixed
-    seed gives a deterministic crop schedule. Offsets live in lax
-    dynamic_slice starts, so the op traces with static shapes (MXU-safe)."""
+    uniform offset. seed attr 0 means "use the executor rng stream"; a
+    nonzero seed is folded INTO that stream — deterministic per (program
+    seed, step) yet still varying step to step, the role the reference's
+    Seed->SeedOut chaining plays (a raw PRNGKey(seed) would repeat the
+    same offsets every batch, silently degrading augmentation). Offsets
+    live in lax dynamic_slice starts, so the op traces with static shapes
+    (MXU-safe)."""
     x = first(ins, "X")
     crop = tuple(int(s) for s in attrs["shape"])
     k = len(crop)
@@ -416,7 +420,9 @@ def random_crop_op(ctx, ins, attrs):
                 f"{x.shape[x.ndim - k + i]}")
     batch_shape = tuple(x.shape[:x.ndim - k])
     seed = int(attrs.get("seed", 0) or 0)
-    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
+    key = ctx.next_rng()
+    if seed:
+        key = jax.random.fold_in(key, seed)
     n = int(np.prod(batch_shape)) if batch_shape else 1
     xf = x.reshape((n,) + tuple(x.shape[x.ndim - k:]))
     maxoff = jnp.asarray(

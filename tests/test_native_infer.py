@@ -124,7 +124,7 @@ def test_errors_are_surfaced(tmp_path):
     def build():
         x = fluid.layers.data(name="x", shape=[4], dtype="float32")
         label = fluid.layers.data(name="label", shape=[1], dtype="float32")
-        y = fluid.layers.fc(input=x, size=2)
+        y = fluid.layers.fc(input=x, size=1)
         loss = fluid.layers.mean(
             fluid.layers.square_error_cost(input=y, label=label))
         return [x], [y], loss

@@ -314,7 +314,15 @@ class TestRandomCrop:
         got2, = exe.run(main, feed={"x": x}, fetch_list=[out])
         got1, got2 = np.asarray(got1), np.asarray(got2)
         assert got1.shape == (2, 1, 5, 5), got1.shape
-        np.testing.assert_allclose(got1, got2)  # seeded => deterministic
+        # seeded => the SCHEDULE is deterministic (reference Seed->SeedOut
+        # chaining): step 2 differs from step 1, but a fresh executor
+        # replays the identical sequence
+        assert not np.allclose(got1, got2), "crops must vary per step"
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        re1, = exe2.run(main, feed={"x": x}, fetch_list=[out])
+        re2, = exe2.run(main, feed={"x": x}, fetch_list=[out])
+        np.testing.assert_allclose(got1, np.asarray(re1))
+        np.testing.assert_allclose(got2, np.asarray(re2))
         # each instance is a contiguous window: verify via value arithmetic
         for b in range(2):
             win = got1[b, 0]
@@ -344,13 +352,10 @@ class TestRandomCropUnseeded:
     def test_bad_crop_shape_raises(self):
         import paddle_tpu as fluid
         import pytest as _pytest
+        # the shape contract rejects the oversized crop at BUILD time
+        # (reference InferShape parity) — it used to surface at run time
         with fluid.program_guard(fluid.Program(), fluid.Program()):
             xv = fluid.layers.data(name="x", shape=[1, 4, 4],
                                    dtype="float32")
-            out = fluid.layers.random_crop(xv, shape=[1, 9, 9])
-            main = fluid.default_main_program()
-        exe = fluid.Executor(fluid.CPUPlace())
-        with _pytest.raises(Exception, match="random_crop"):
-            exe.run(main,
-                    feed={"x": np.zeros((2, 1, 4, 4), "float32")},
-                    fetch_list=[out])
+            with _pytest.raises(Exception, match="random_crop"):
+                fluid.layers.random_crop(xv, shape=[1, 9, 9])

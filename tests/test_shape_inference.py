@@ -152,3 +152,17 @@ def test_contract_error_names_op_and_inputs():
             assert "x" in msg and "y" in msg
         else:
             pytest.fail("expected ShapeError")
+
+
+def test_every_registered_op_has_a_contract():
+    """r3 VERDICT task 4: reference parity means EVERY op declares
+    InferShape (shape_inference.h via op_desc.cc) — 100% of the registry,
+    not a high-traffic subset. Grad ops derive from their forward op's
+    kernel (registry.make_vjp_kernel) and are exercised through it."""
+    from paddle_tpu.core import registry, shape_inference
+
+    missing = [
+        t for t in registry.registered_ops()
+        if not t.endswith("_grad") and not shape_inference.has_contract(t)
+    ]
+    assert not missing, f"ops without a shape contract: {missing}"
