@@ -60,6 +60,8 @@ from .io import (
 from .backward import append_backward, calc_gradient
 from .optimizer import (
     SGD,
+    ProximalGD,
+    ProximalAdagrad,
     Momentum,
     Adagrad,
     Adam,

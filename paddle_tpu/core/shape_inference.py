@@ -621,6 +621,8 @@ _OPT_STATE_SLOTS = {
     "adadelta": ["AvgSquaredGrad", "AvgSquaredUpdate"],
     "rmsprop": ["MeanSquare", "Moment"],
     "ftrl": ["SquaredAccumulator", "LinearAccumulator"],
+    "proximal_gd": [],
+    "proximal_adagrad": ["Moment"],
 }
 
 
@@ -1042,9 +1044,9 @@ def _iou_similarity(ctx):
     x = ctx.input_dim("X")
     y = ctx.input_dim("Y")
     if x is not None:
-        ctx.enforce(x[-1] == 4, f"X{x} last dim must be 4 (boxes)")
+        ctx.enforce(_dim_match(x[-1], 4), f"X{x} last dim must be 4 (boxes)")
     if y is not None:
-        ctx.enforce(y[-1] == 4, f"Y{y} last dim must be 4 (boxes)")
+        ctx.enforce(_dim_match(y[-1], 4), f"Y{y} last dim must be 4 (boxes)")
     if x is not None and y is not None:
         ctx.set_output_dim("Out", (x[0], y[0]))
 
@@ -1053,7 +1055,7 @@ def _iou_similarity(ctx):
 def _box_coder(ctx):
     pb = ctx.input_dim("PriorBox")
     if pb is not None:
-        ctx.enforce(pb[-1] == 4, f"PriorBox{pb} last dim must be 4")
+        ctx.enforce(_dim_match(pb[-1], 4), f"PriorBox{pb} last dim must be 4")
 
 
 @register_infer_shape("bipartite_match", "target_assign",
@@ -1256,3 +1258,43 @@ def _roi_pool(ctx):
         out = (rois[0], x[1], ph, pw)
         ctx.set_output_dim("Out", out)
         ctx.set_output_dim("Argmax", out)
+
+
+@register_infer_shape("spp")
+def _spp(ctx):
+    x = ctx.input_dim("X")
+    if x is None:
+        return
+    ctx.enforce(len(x) == 4, f"X must be NCHW 4-D, got {x}")
+    p = ctx.attr("pyramid_height", 1)
+    bins = 2 ** (p - 1)
+    for d in (2, 3):
+        if x[d] != -1:
+            ctx.enforce(bins <= x[d],
+                        f"pyramid level {p - 1} needs {bins} bins but X{x} "
+                        f"dim {d} is only {x[d]} (windows would lie wholly "
+                        f"in padding: -inf/NaN outputs)")
+    # sum of 4^level bins over the pyramid (reference spp_op.cc:74)
+    if x[1] != -1:
+        ctx.set_output_dim("Out", (x[0], x[1] * (4 ** p - 1) // 3))
+
+
+@register_infer_shape("unpool")
+def _unpool(ctx):
+    x = ctx.input_dim("X")
+    idx = ctx.input_dim("Indices")
+    if x is not None and idx is not None:
+        ctx.enforce(_shapes_match(x, idx),
+                    f"Indices{idx} must match X{x}")
+    if x is None:
+        return
+    ctx.enforce(len(x) == 4, f"X must be NCHW 4-D, got {x}")
+    k = ctx.attr("ksize")
+    ctx.enforce(k is not None and len(k) == 2,
+                "unpool requires a 2-entry ksize attr (the kernel has no "
+                "default)")
+    s = ctx.attr("strides", [1, 1])
+    p = ctx.attr("paddings", [0, 0])
+    oh = -1 if x[2] == -1 else (x[2] - 1) * s[0] - 2 * p[0] + k[0]
+    ow = -1 if x[3] == -1 else (x[3] - 1) * s[1] - 2 * p[1] + k[1]
+    ctx.set_output_dim("Out", (x[0], x[1], oh, ow))

@@ -524,3 +524,60 @@ def im2sequence_op(ctx, ins, attrs):
     seq = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
     lengths = jnp.full((n,), oh * ow, jnp.int32)
     return out(Out=SeqTensor(seq, lengths))
+
+
+@register_op("spp")
+def spp_op(ctx, ins, attrs):
+    """Spatial pyramid pooling (reference operators/spp_op.{cc,h}): level p
+    pools X [N,C,H,W] onto a bins x bins grid (bins = 2^p) with
+    ksize = ceil(dim/bins) and the reference's centering padding, then the
+    flattened levels concat to [N, C*(4^P-1)/3]. Each level is one
+    lax.reduce_window — static shapes, XLA-fusable; P is tiny so the
+    Python loop unrolls into the trace."""
+    x = first(ins, "X")
+    p_height = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    levels = []
+    for p in range(p_height):
+        bins = 2 ** p
+        kh, kw = -(-h // bins), -(-w // bins)
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        dims = (1, 1, kh, kw)
+        strides = (1, 1, kh, kw)
+        pads = ((0, 0), (0, 0), (ph, kh * bins - h - ph),
+                (pw, kw * bins - w - pw))
+        if ptype == "max":
+            lvl = lax.reduce_window(
+                x, -jnp.inf, lax.max, dims, strides, pads).astype(x.dtype)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+            # divide by the REAL element count per window (padding
+            # excluded), the reference Pool2dFunctor's clipped-window rule
+            cnt = lax.reduce_window(
+                jnp.ones_like(x), 0.0, lax.add, dims, strides, pads)
+            lvl = (s / cnt).astype(x.dtype)
+        levels.append(lvl.reshape(n, c * bins * bins))
+    return out(Out=jnp.concatenate(levels, axis=1))
+
+
+@register_op("unpool")
+def unpool_op(ctx, ins, attrs):
+    """Max-unpool 2d (reference operators/unpool_op.{cc,h}): scatter each
+    pooled value back to the position its flat index names inside the
+    unpooled H*W plane; everything else is zero. One batched scatter —
+    the TPU-native form of the reference's per-element loop."""
+    x = first(ins, "X")
+    idx = first(ins, "Indices")
+    n, c, h, w = x.shape
+    ksize = attrs["ksize"]
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0])
+    ho = (h - 1) * strides[0] - 2 * pads[0] + ksize[0]
+    wo = (w - 1) * strides[1] - 2 * pads[1] + ksize[1]
+    flat_idx = idx.reshape(n, c, h * w).astype(jnp.int32)
+    vals = x.reshape(n, c, h * w)
+    bn = jnp.arange(n)[:, None, None]
+    bc = jnp.arange(c)[None, :, None]
+    o = jnp.zeros((n, c, ho * wo), x.dtype).at[bn, bc, flat_idx].set(vals)
+    return out(Out=o.reshape(n, c, ho, wo))

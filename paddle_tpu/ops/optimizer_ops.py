@@ -151,3 +151,35 @@ def ftrl_op(ctx, ins, attrs):
     y = jnp.power(new_sq, -power) / lr + 2 * l2
     p_out = x / y
     return out(ParamOut=p_out.astype(p.dtype), SquaredAccumOut=new_sq, LinearAccumOut=lin_out)
+
+
+def _soft_threshold(prox, lr, l1, l2):
+    """The proximal operator of l1/l2 regularization (reference
+    proximal_gd_op.h:49-58): soft-threshold by lr*l1, shrink by 1+lr*l2."""
+    if l1 > 0:
+        return (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                / (1.0 + lr * l2))
+    return prox / (1.0 + lr * l2)
+
+
+@register_op("proximal_gd")
+def proximal_gd_op(ctx, ins, attrs):
+    """reference operators/proximal_gd_op.{cc,h}."""
+    p, g = first(ins, "Param"), first(ins, "Grad")
+    lr = first(ins, "LearningRate").reshape(()).astype(jnp.float32)
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    prox = p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+    return out(ParamOut=_soft_threshold(prox, lr, l1, l2).astype(p.dtype))
+
+
+@register_op("proximal_adagrad")
+def proximal_adagrad_op(ctx, ins, attrs):
+    """reference operators/proximal_adagrad_op.{cc,h}."""
+    p, g, m = first(ins, "Param"), first(ins, "Grad"), first(ins, "Moment")
+    lr = first(ins, "LearningRate").reshape(()).astype(jnp.float32)
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    gf = g.astype(jnp.float32)
+    m_out = m + gf * gf
+    prox = p.astype(jnp.float32) - lr * gf / jnp.sqrt(m_out)
+    return out(ParamOut=_soft_threshold(prox, lr, l1, l2).astype(p.dtype),
+               MomentOut=m_out)

@@ -26,7 +26,8 @@ __all__ = [
     "Adadelta", "RMSProp", "Optimizer", "SGDOptimizer", "MomentumOptimizer",
     "AdagradOptimizer", "AdamOptimizer", "AdamaxOptimizer",
     "DecayedAdagradOptimizer", "AdadeltaOptimizer", "RMSPropOptimizer",
-    "Ftrl", "FtrlOptimizer", "ModelAverage",
+    "Ftrl", "FtrlOptimizer", "ProximalGD", "ProximalGDOptimizer",
+    "ProximalAdagrad", "ProximalAdagradOptimizer", "ModelAverage",
 ]
 
 
@@ -592,6 +593,61 @@ class FtrlOptimizer(Optimizer):
         )
 
 
+class ProximalGDOptimizer(Optimizer):
+    """Proximal gradient descent with l1/l2 regularization (reference
+    operators/proximal_gd_op.cc; optimizer surface parity with the op
+    library)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "proximal_gd"
+        self._l1 = l1
+        self._l2 = l2
+
+    def _append_optimize_op(self, block, param_and_grad):
+        return block.append_op(
+            "proximal_gd",
+            {
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            {"ParamOut": [param_and_grad[0]]},
+            {"l1": self._l1, "l2": self._l2},
+        )
+
+
+class ProximalAdagradOptimizer(Optimizer):
+    """Proximal Adagrad (reference operators/proximal_adagrad_op.cc)."""
+
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "proximal_adagrad"
+        self._l1 = l1
+        self._l2 = l2
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator(
+            self._moment_acc_str, param_and_grad[0])
+        return block.append_op(
+            "proximal_adagrad",
+            {
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "Moment": [moment],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            {"ParamOut": [param_and_grad[0]], "MomentOut": [moment]},
+            {"l1": self._l1, "l2": self._l2},
+        )
+
+
 # aliases (reference exposes both short and long names)
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
@@ -602,3 +658,5 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer

@@ -49,30 +49,26 @@ def same_or_split_var(p_name, var_name):
 
 
 def split_dense_variable(var_list, service_count, min_block_size=8192):
-    """reference distribute_transpiler.py:98 — chop each var into blocks of
-    >= min_block_size elements, at most `service_count` blocks per var."""
-    blocks = []
-    for var in var_list:
-        split_count = service_count
-        var_numel = int(math.prod(var.shape)) if var.shape else 1
-        max_pserver_count = int(math.floor(var_numel / float(min_block_size)))
-        if max_pserver_count == 0:
-            max_pserver_count = 1
-        if max_pserver_count < service_count:
-            split_count = max_pserver_count
-        block_size = int(math.ceil(var_numel / float(split_count)))
+    """Plan the pserver sharding of each dense variable.
 
+    Policy (role parity with reference distribute_transpiler.py:98-140):
+    at most `service_count` shards per var, no shard below `min_block_size`
+    elements (tiny vars stay whole), and rank>=2 vars cut on whole rows so
+    every shard is a contiguous row range of the original tensor.
+    """
+    plans = []
+    for var in var_list:
+        numel = int(math.prod(var.shape)) if var.shape else 1
+        # widest shard count this var supports while honouring the floor
+        shards = max(1, min(service_count, numel // min_block_size))
+        per_shard = -(-numel // shards)  # ceil div
         if len(var.shape) >= 2:
-            dim1 = int(math.prod(var.shape[1:]))
-            remains = block_size % dim1
-            if remains != 0:
-                block_size += dim1 - remains
-        split_count = int(math.ceil(var_numel / float(block_size)))
-        for block_id in range(split_count):
-            curr_block_size = min(block_size, var_numel - (block_id * block_size))
-            block = VarBlock(var.name, block_id, curr_block_size)
-            blocks.append(str(block))
-    return blocks
+            row = int(math.prod(var.shape[1:]))
+            per_shard = -(-per_shard // row) * row  # round UP to whole rows
+        for i in range(-(-numel // per_shard)):
+            plans.append(str(VarBlock(
+                var.name, i, min(per_shard, numel - i * per_shard))))
+    return plans
 
 
 class DistributeTranspiler:

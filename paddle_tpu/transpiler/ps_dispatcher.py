@@ -1,10 +1,24 @@
-"""Parameter-server shard dispatchers (reference
-python/paddle/fluid/transpiler/ps_dispatcher.py: RoundRobin:42, HashName:62)."""
+"""Placement policies mapping split variable blocks onto pserver endpoints.
+
+Role parity with reference python/paddle/fluid/transpiler/ps_dispatcher.py
+(RoundRobin / HashName surface), re-expressed for this build. One deliberate
+upgrade: HashName uses a stable digest (crc32) rather than Python's
+process-seeded hash() — trainers and pservers compute placement
+independently, and with PYTHONHASHSEED randomization a builtin-hash scheme
+can assign the same parameter block to different endpoints in different
+processes.
+"""
+
+import zlib
+
+__all__ = ["PSDispatcher", "RoundRobin", "HashName"]
 
 
 class PSDispatcher:
+    """Base policy: subclasses decide which endpoint serves each block."""
+
     def __init__(self, pserver_endpoints):
-        self._eps = pserver_endpoints
+        self._eps = list(pserver_endpoints)
         self._step = 0
 
     @property
@@ -15,39 +29,31 @@ class PSDispatcher:
         self._step = 0
 
     def dispatch(self, varlist):
-        raise NotImplementedError("Interface has not been implemented.")
-
-
-class HashName(PSDispatcher):
-    """Hash variable names to pserver endpoints."""
-
-    def __init__(self, pserver_endpoints):
-        super().__init__(pserver_endpoints)
-
-    def _hash_block(self, block_str, total):
-        return hash(block_str) % total
-
-    def dispatch(self, varlist):
-        eplist = []
-        for var in varlist:
-            server_id = self._hash_block(var.name(), len(self._eps))
-            server_for_param = self._eps[server_id]
-            eplist.append(server_for_param)
-        return eplist
+        """varlist: split Variables -> endpoint per variable (parallel list)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement dispatch()")
 
 
 class RoundRobin(PSDispatcher):
-    """Distribute variables round-robin."""
-
-    def __init__(self, pserver_endpoints):
-        super().__init__(pserver_endpoints)
+    """Deal blocks out like cards: step through the endpoint ring, keeping
+    the cursor across calls so successive dispatch() calls stay balanced."""
 
     def dispatch(self, varlist):
-        eplist = []
-        for var in varlist:
-            server_for_param = self._eps[self._step]
-            eplist.append(server_for_param)
-            self._step += 1
-            if self._step >= len(self._eps):
-                self._step = 0
-        return eplist
+        n = len(self._eps)
+        chosen = [self._eps[(self._step + i) % n]
+                  for i in range(len(varlist))]
+        self._step = (self._step + len(varlist)) % n
+        return chosen
+
+
+class HashName(PSDispatcher):
+    """Stable name-keyed placement: the same variable name always lands on
+    the same endpoint, in every process, regardless of dispatch order."""
+
+    @staticmethod
+    def _bucket(name, buckets):
+        return zlib.crc32(name.encode("utf-8")) % buckets
+
+    def dispatch(self, varlist):
+        return [self._eps[self._bucket(v.name, len(self._eps))]
+                for v in varlist]

@@ -259,3 +259,51 @@ def test_sync_two_trainers_grads_aggregate():
     np.testing.assert_allclose(results[0], results[1])
     from paddle_tpu.parallel.rpc import VariableClient
     VariableClient("127.0.0.1:6310").shutdown()
+
+
+def test_ps_dispatchers():
+    """Placement policies: round-robin balance with a persistent cursor, and
+    process-stable name-keyed hashing (crc32, not the seeded builtin hash —
+    trainers and pservers must agree on placement independently)."""
+    from paddle_tpu.transpiler.ps_dispatcher import RoundRobin, HashName
+
+    class V:
+        def __init__(self, name):
+            self.name = name
+
+    eps = ["a:1", "b:2", "c:3"]
+    rr = RoundRobin(eps)
+    got = rr.dispatch([V("p0"), V("p1")])
+    assert got == ["a:1", "b:2"]
+    got = rr.dispatch([V("p2"), V("p3")])  # cursor persists across calls
+    assert got == ["c:3", "a:1"]
+    rr.reset()
+    assert rr.dispatch([V("x")]) == ["a:1"]
+
+    h = HashName(eps)
+    one = h.dispatch([V("w.block0"), V("w.block1"), V("b.block0")])
+    # same names -> same endpoints, in any order and on any process
+    again = h.dispatch([V("b.block0"), V("w.block0")])
+    assert again == [one[2], one[0]]
+    import zlib
+    assert one[0] == eps[zlib.crc32(b"w.block0") % 3]
+
+
+def test_split_dense_variable_plans():
+    from paddle_tpu.transpiler.distribute_transpiler import (
+        split_dense_variable)
+
+    class V:
+        def __init__(self, name, shape):
+            self.name = name
+            self.shape = shape
+
+    # tiny var: one whole block despite 4 servers
+    assert split_dense_variable([V("b", (10,))], 4) == ["b:0:10"]
+    # big 2-D var: row-aligned shards covering exactly numel
+    plans = split_dense_variable([V("w", (1000, 64))], 4,
+                                 min_block_size=8192)
+    sizes = [int(p.split(":")[2]) for p in plans]
+    assert sum(sizes) == 1000 * 64
+    assert len(plans) == 4
+    assert all(s % 64 == 0 for s in sizes)

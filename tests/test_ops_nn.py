@@ -359,3 +359,142 @@ class TestRandomCropUnseeded:
                                    dtype="float32")
             with _pytest.raises(Exception, match="random_crop"):
                 fluid.layers.random_crop(xv, shape=[1, 9, 9])
+
+
+
+class TestSpp(OpTest):
+    """spp vs a numpy pyramid-pool reference (operators/spp_op.h).
+    Permutation-spaced values keep finite differences from flipping any
+    window's argmax in the grad check."""
+
+    def _np_spp(self, x, p_height, ptype):
+        n, c, h, w = x.shape
+        outs = []
+        for p in range(p_height):
+            bins = 2 ** p
+            kh, kw = -(-h // bins), -(-w // bins)
+            ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+            lvl = np.zeros((n, c, bins, bins), x.dtype)
+            for i in range(bins):
+                for j in range(bins):
+                    h0, h1 = max(i * kh - ph, 0), min(i * kh - ph + kh, h)
+                    w0, w1 = max(j * kw - pw, 0), min(j * kw - pw + kw, w)
+                    win = x[:, :, h0:h1, w0:w1]
+                    lvl[:, :, i, j] = (win.max((2, 3)) if ptype == "max"
+                                       else win.mean((2, 3)))
+            outs.append(lvl.reshape(n, c * bins * bins))
+        return np.concatenate(outs, 1)
+
+    def setup(self):
+        rs = np.random.RandomState(11)
+        x = (rs.permutation(1 * 2 * 6 * 6).astype("float32") * 0.1
+             ).reshape(1, 2, 6, 6)
+        self.op_type = "spp"
+        self.attrs = {"pyramid_height": 2, "pooling_type": "max"}
+        self.inputs = {"X": x}
+        self.outputs = {"Out": self._np_spp(x, 2, "max")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.02,
+                        numeric_delta=1e-2)
+
+
+class TestSppAvg(TestSpp):
+    def setup(self):
+        rs = np.random.RandomState(6)
+        x = rs.rand(2, 2, 7, 7).astype("float32")  # 7: uneven bins + pad
+        self.op_type = "spp"
+        self.attrs = {"pyramid_height": 2, "pooling_type": "avg"}
+        self.inputs = {"X": x}
+        self.outputs = {"Out": self._np_spp(x, 2, "avg")}
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.02,
+                        numeric_delta=1e-2)
+
+
+class TestUnpool(OpTest):
+    """max-unpool scatter vs numpy (operators/unpool_op.h)."""
+
+    def setup(self):
+        rs = np.random.RandomState(7)
+        n, c, h, w = 2, 3, 2, 2
+        ks, st, pd = [2, 2], [2, 2], [0, 0]
+        ho, wo = 4, 4
+        x = rs.rand(n, c, h, w).astype("float32")
+        # valid, unique flat indices per window position
+        idx = np.zeros((n, c, h, w), np.int64)
+        for i in range(h):
+            for j in range(w):
+                idx[:, :, i, j] = (i * 2) * wo + (j * 2) + \
+                    rs.randint(0, 2, (n, c)) * (wo + 1)
+        want = np.zeros((n, c, ho * wo), np.float32)
+        for b in range(n):
+            for ch in range(c):
+                want[b, ch, idx[b, ch].ravel()] = x[b, ch].ravel()
+        self.op_type = "unpool"
+        self.attrs = {"ksize": ks, "strides": st, "paddings": pd,
+                      "unpooling_type": "max"}
+        self.inputs = {"X": x, "Indices": idx}
+        self.outputs = {"Out": want.reshape(n, c, ho, wo)}
+
+    def test_output(self):
+        self.check_output()
+
+
+def _proximal_gd_case(l1):
+    rs = np.random.RandomState(8)
+    p = rs.rand(4, 3).astype("float32")
+    g = rs.rand(4, 3).astype("float32")
+    lr = np.asarray([0.05], np.float32)
+    l2 = 0.2
+    prox = p - lr * g
+    if l1 > 0:
+        want = np.sign(prox) * np.maximum(np.abs(prox) - lr * l1, 0) \
+            / (1 + lr * l2)
+    else:
+        want = prox / (1 + lr * l2)
+    return p, g, lr, l2, want.astype("float32")
+
+
+class TestProximalGD(OpTest):
+    l1 = 0.1
+
+    def setup(self):
+        p, g, lr, l2, want = _proximal_gd_case(self.l1)
+        self.op_type = "proximal_gd"
+        self.attrs = {"l1": self.l1, "l2": l2}
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.outputs = {"ParamOut": want}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestProximalGDNoL1(TestProximalGD):
+    l1 = 0.0
+
+
+class TestProximalAdagrad(OpTest):
+    def setup(self):
+        rs = np.random.RandomState(9)
+        p = rs.rand(5, 2).astype("float32")
+        g = rs.rand(5, 2).astype("float32")
+        m = rs.rand(5, 2).astype("float32")
+        lr = np.asarray([0.1], np.float32)
+        l1, l2 = 0.05, 0.1
+        m_out = m + g * g
+        prox = p - lr * g / np.sqrt(m_out)
+        want = np.sign(prox) * np.maximum(np.abs(prox) - lr * l1, 0)             / (1 + lr * l2)
+        self.op_type = "proximal_adagrad"
+        self.attrs = {"l1": l1, "l2": l2}
+        self.inputs = {"Param": p, "Grad": g, "Moment": m,
+                       "LearningRate": lr}
+        self.outputs = {"ParamOut": want.astype("float32"),
+                        "MomentOut": m_out}
+
+    def test_output(self):
+        self.check_output()

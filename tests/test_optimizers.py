@@ -122,3 +122,36 @@ def test_gradient_clip_by_global_norm():
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
     exe.run(main, feed={"x": np.ones((2, 3), "float32")}, fetch_list=[loss])
+
+
+def test_proximal_optimizers_converge():
+    """ProximalGD / ProximalAdagrad drive a least-squares fit through the
+    public optimizer surface (reference proximal_{gd,adagrad}_op.cc)."""
+    import paddle_tpu as fluid
+
+    for opt in (fluid.optimizer.ProximalGD(learning_rate=0.1, l1=1e-4,
+                                           l2=1e-4),
+                fluid.optimizer.ProximalAdagrad(learning_rate=0.5, l1=1e-4,
+                                                l2=1e-4)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            p = fluid.layers.fc(input=x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=p, label=y))
+            opt.minimize(loss)
+        scope = fluid.Scope()
+        rs = np.random.RandomState(0)
+        W = rs.randn(4, 1).astype("float32")
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = []
+            for _ in range(30):
+                xv = rs.randn(16, 4).astype("float32")
+                yv = xv @ W
+                l, = exe.run(main, feed={"x": xv, "y": yv},
+                             fetch_list=[loss])
+                losses.append(float(np.asarray(l).mean()))
+        assert losses[-1] < losses[0] * 0.5, (type(opt).__name__, losses)
