@@ -15,14 +15,15 @@ import os
 import shutil
 import time
 
-from .core.framework import Program, Parameter, Variable, default_main_program
+from .core.framework import (Program, Parameter, Variable,
+                             default_main_program, default_startup_program)
 from .executor import Executor
 
 __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars", "load_params",
     "load_persistables", "save_inference_model", "load_inference_model",
     "get_inference_program", "save_checkpoint", "load_checkpoint",
-    "clean_checkpoint",
+    "clean_checkpoint", "save_train_model",
 ]
 
 SUCCESS_MARK_FILENAME = "_SUCCESS"
@@ -185,6 +186,34 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         )
     save_persistables(executor, dirname, inference_program, params_filename)
     return fetch_var_names
+
+
+def save_train_model(dirname, feeded_var_names, loss, main_program=None,
+                     startup_program=None):
+    """Serialize a FULL training program (forward + backward + optimizer
+    ops) plus its startup program for the native C++ trainer
+    (native/train.cc; reference parity: the ProgramDesc + init program
+    fluid/train/demo/demo_trainer.cc loads). No parameters are written —
+    the native side runs the startup initializers itself."""
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if main_program is None:
+        main_program = default_main_program()
+    if startup_program is None:
+        startup_program = default_startup_program()
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "__train__"), "w") as f:
+        json.dump(
+            {
+                "main_program": main_program.to_dict(),
+                "startup_program": startup_program.to_dict(),
+                "feed_var_names": feeded_var_names,
+                "loss_name": loss.name if isinstance(loss, Variable)
+                else str(loss),
+            },
+            f,
+        )
+    return dirname
 
 
 def load_inference_model(dirname, executor, model_filename=None,

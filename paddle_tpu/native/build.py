@@ -12,13 +12,14 @@ import sys
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
 
-def build_library(name, sources, extra_flags=()):
+def build_library(name, sources, extra_flags=(), deps=()):
     """Compile sources into lib<name>.so next to this file; returns path.
-    Rebuilds only when a source is newer than the binary."""
+    Rebuilds when a source OR header dependency is newer than the binary."""
     out = os.path.join(_HERE, f"lib{name}.so")
     srcs = [os.path.join(_HERE, s) for s in sources]
+    watch = srcs + [os.path.join(_HERE, d) for d in deps]
     if os.path.exists(out) and all(
-            os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs):
+            os.path.getmtime(out) >= os.path.getmtime(s) for s in watch):
         return out
     cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", out,
            *srcs, *extra_flags]
@@ -37,4 +38,8 @@ def recordio_lib():
 
 
 def infer_lib():
-    return build_library("ptinfer", ["infer.cc"])
+    return build_library("ptinfer", ["infer.cc"], deps=["runtime.h"])
+
+
+def train_lib():
+    return build_library("pttrain", ["train.cc"], deps=["runtime.h"])

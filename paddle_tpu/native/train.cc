@@ -1,0 +1,331 @@
+// Native training demo runtime: load a saved TRAIN program (forward +
+// backward + optimizer ops, JSON IR) and run real training steps C++-only —
+// no Python anywhere in the loop.
+//
+// Reference parity: paddle/fluid/train/demo/demo_trainer.cc — it loads a
+// ProgramDesc, runs the startup program to initialize parameters, then
+// executes the train program step by step with an SGD update. Same shape
+// here: ptt_create parses __train__ (startup + main programs),
+// ptt_init runs the startup ops (uniform/gaussian/constant initializers),
+// ptt_step feeds a batch, runs forward+backward+sgd, and returns the loss.
+//
+// The forward kernels come from the shared runtime (runtime.h run_op); this
+// file adds what training needs on top: initializer kernels, the gradient
+// kernels the IR-level backward emits for the demo-net family
+// (mean/square_error_cost/elementwise_add/mul/relu), and the sgd update,
+// applied in place on the persistent scope.
+//
+// Build: paddle_tpu/native/build.py train_lib() -> libpttrain.so
+// ABI (0 on success, -1 on error; ptt_last_error()):
+//   void*  ptt_create(const char* model_dir);
+//   int    ptt_init(void*);                       // run startup program
+//   int    ptt_step(void*, int n, const char** names, const int* dtypes,
+//                   const int* ndims, const int64_t* dims_concat,
+//                   const void** datas, float* loss_out);
+//   int    ptt_get_var(void*, const char* name, int* dtype, int* ndim,
+//                      const int64_t** dims, const void** data);
+//   void   ptt_destroy(void*);
+
+#include "runtime.h"
+
+#include <random>
+
+namespace {
+
+using namespace ptnative;
+
+struct Trainer {
+  std::vector<OpDesc> startup_ops, main_ops;
+  std::vector<std::string> feed_names;
+  std::string loss_name;
+  Scope scope;  // persistent: parameters + optimizer state
+  std::mt19937 rng{7};
+  Tensor fetched;
+};
+
+std::vector<int64_t> attr_shape(const OpDesc& op) {
+  return op.attr_ints("shape");
+}
+
+Tensor make_f32(const std::vector<int64_t>& dims) {
+  Tensor t;
+  t.dtype = F32;
+  t.dims = dims;
+  t.alloc();
+  return t;
+}
+
+// gradient of the elementwise broadcast: fold dOut back onto y's shape
+// (sum over the pre/post extents the forward broadcast expanded)
+Tensor reduce_to_like(const Tensor& dout, const Tensor& y, int axis) {
+  if (y.dims == dout.dims) return to_f32(dout);
+  int xr = (int)dout.dims.size(), yr = (int)y.dims.size();
+  while (yr > 1 && y.dims[yr - 1] == 1) --yr;
+  if (axis < 0) axis = xr - yr;
+  int64_t pre = 1, mid = 1, post = 1;
+  for (int i = 0; i < axis; ++i) pre *= dout.dims[i];
+  for (int i = 0; i < yr; ++i) mid *= dout.dims[axis + i];
+  for (int i = axis + yr; i < xr; ++i) post *= dout.dims[i];
+  Tensor d_s;
+  const Tensor& d = as_f32(dout, d_s);
+  Tensor o = make_f32(y.dims);
+  std::fill(o.f(), o.f() + o.numel(), 0.f);
+  for (int64_t a = 0; a < pre; ++a)
+    for (int64_t b = 0; b < mid; ++b)
+      for (int64_t c = 0; c < post; ++c)
+        o.f()[b] += d.f()[(a * mid + b) * post + c];
+  return o;
+}
+
+// returns true when handled; false -> fall through to the inference run_op
+bool run_train_op(Trainer& tr, const OpDesc& op, Env& env) {
+  const std::string& t = op.type;
+
+  if (t == "fill_constant") {
+    Tensor o = make_f32(attr_shape(op));
+    float v = (float)op.attr_num("value", 0.0);
+    std::fill(o.f(), o.f() + o.numel(), v);
+    const std::string& name = op.out("Out");
+    if (env.params == nullptr)  // startup: write the persistent scope
+      tr.scope[name] = std::move(o);
+    else
+      env.local[name] = std::move(o);
+    return true;
+  }
+  if (t == "uniform_random" || t == "gaussian_random") {
+    Tensor o = make_f32(attr_shape(op));
+    if (t == "uniform_random") {
+      float lo = (float)op.attr_num("min", -1.0);
+      float hi = (float)op.attr_num("max", 1.0);
+      std::uniform_real_distribution<float> dist(lo, hi);
+      for (int64_t i = 0; i < o.numel(); ++i) o.f()[i] = dist(tr.rng);
+    } else {
+      float mean = (float)op.attr_num("mean", 0.0);
+      float std_ = (float)op.attr_num("std", 1.0);
+      std::normal_distribution<float> dist(mean, std_);
+      for (int64_t i = 0; i < o.numel(); ++i) o.f()[i] = dist(tr.rng);
+    }
+    const std::string& name = op.out("Out");
+    if (env.params == nullptr)
+      tr.scope[name] = std::move(o);
+    else
+      env.local[name] = std::move(o);
+    return true;
+  }
+
+  if (t == "square_error_cost") {
+    Tensor x_s, y_s;
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
+    const Tensor& y = as_f32(need(env, op.in("Y")), y_s);
+    Tensor o = make_f32(x.dims);
+    for (int64_t i = 0; i < x.numel(); ++i) {
+      float d = x.f()[i] - y.f()[i];
+      o.f()[i] = d * d;
+    }
+    env.local[op.out("Out")] = std::move(o);
+    return true;
+  }
+
+  if (t == "mean_grad") {
+    const Tensor& x = need(env, op.in("X"));
+    Tensor d_s;
+    const Tensor& dout = as_f32(need(env, op.in("Out@GRAD")), d_s);
+    Tensor o = make_f32(x.dims);
+    float g = dout.f()[0] / (float)x.numel();
+    std::fill(o.f(), o.f() + o.numel(), g);
+    env.local[op.out("X@GRAD")] = std::move(o);
+    return true;
+  }
+  if (t == "square_error_cost_grad") {
+    Tensor x_s, y_s, d_s;
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
+    const Tensor& y = as_f32(need(env, op.in("Y")), y_s);
+    const Tensor& dout = as_f32(need(env, op.in("Out@GRAD")), d_s);
+    if (!op.out("X@GRAD").empty()) {
+      Tensor o = make_f32(x.dims);
+      for (int64_t i = 0; i < x.numel(); ++i)
+        o.f()[i] = 2.f * (x.f()[i] - y.f()[i]) * dout.f()[i];
+      env.local[op.out("X@GRAD")] = std::move(o);
+    }
+    if (!op.out("Y@GRAD").empty()) {
+      Tensor o = make_f32(y.dims);
+      for (int64_t i = 0; i < y.numel(); ++i)
+        o.f()[i] = -2.f * (x.f()[i] - y.f()[i]) * dout.f()[i];
+      env.local[op.out("Y@GRAD")] = std::move(o);
+    }
+    return true;
+  }
+  if (t == "elementwise_add_grad") {
+    const Tensor& y = need(env, op.in("Y"));
+    Tensor d_s;
+    const Tensor& dout = as_f32(need(env, op.in("Out@GRAD")), d_s);
+    if (!op.out("X@GRAD").empty())
+      env.local[op.out("X@GRAD")] = to_f32(dout);
+    if (!op.out("Y@GRAD").empty())
+      env.local[op.out("Y@GRAD")] =
+          reduce_to_like(dout, y, (int)op.attr_num("axis", -1));
+    return true;
+  }
+  if (t == "relu_grad") {
+    Tensor x_s, d_s;
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
+    const Tensor& dout = as_f32(need(env, op.in("Out@GRAD")), d_s);
+    Tensor o = make_f32(x.dims);
+    for (int64_t i = 0; i < x.numel(); ++i)
+      o.f()[i] = x.f()[i] > 0.f ? dout.f()[i] : 0.f;
+    env.local[op.out("X@GRAD")] = std::move(o);
+    return true;
+  }
+  if (t == "mul_grad") {
+    Tensor x_s, y_s, d_s;
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
+    const Tensor& y = as_f32(need(env, op.in("Y")), y_s);
+    const Tensor& dout = as_f32(need(env, op.in("Out@GRAD")), d_s);
+    int xn = (int)op.attr_num("x_num_col_dims", 1);
+    int yn = (int)op.attr_num("y_num_col_dims", 1);
+    int64_t m = 1, k = 1, n = 1;
+    for (int i = 0; i < xn; ++i) m *= x.dims[i];
+    for (size_t i = xn; i < x.dims.size(); ++i) k *= x.dims[i];
+    for (size_t i = yn; i < y.dims.size(); ++i) n *= y.dims[i];
+    if (!op.out("X@GRAD").empty()) {  // dX = dOut @ Y^T   [m,k]
+      Tensor o = make_f32(x.dims);
+      for (int64_t i = 0; i < m; ++i)
+        for (int64_t kk = 0; kk < k; ++kk) {
+          float acc = 0.f;
+          for (int64_t j = 0; j < n; ++j)
+            acc += dout.f()[i * n + j] * y.f()[kk * n + j];
+          o.f()[i * k + kk] = acc;
+        }
+      env.local[op.out("X@GRAD")] = std::move(o);
+    }
+    if (!op.out("Y@GRAD").empty()) {  // dY = X^T @ dOut   [k,n]
+      Tensor o = make_f32(y.dims);
+      for (int64_t kk = 0; kk < k; ++kk)
+        for (int64_t j = 0; j < n; ++j) {
+          float acc = 0.f;
+          for (int64_t i = 0; i < m; ++i)
+            acc += x.f()[i * k + kk] * dout.f()[i * n + j];
+          o.f()[kk * n + j] = acc;
+        }
+      env.local[op.out("Y@GRAD")] = std::move(o);
+    }
+    return true;
+  }
+
+  if (t == "sgd") {
+    auto pit = tr.scope.find(op.in("Param"));
+    if (pit == tr.scope.end())
+      throw std::runtime_error("sgd: param not in scope: " + op.in("Param"));
+    Tensor& p = pit->second;
+    Tensor g_s, lr_s;
+    const Tensor& g = as_f32(need(env, op.in("Grad")), g_s);
+    const Tensor& lr = as_f32(need(env, op.in("LearningRate")), lr_s);
+    if (p.dtype != F32) p = to_f32(p);
+    for (int64_t i = 0; i < p.numel(); ++i)
+      p.f()[i] -= lr.f()[0] * g.f()[i];
+    return true;  // ParamOut aliases Param: updated in place
+  }
+
+  return false;
+}
+
+Trainer* create(const std::string& dir) {
+  std::ifstream in(dir + "/__train__");
+  if (!in) throw std::runtime_error("cannot open " + dir + "/__train__");
+  std::stringstream ss;
+  ss << in.rdbuf();
+  std::string text = ss.str();
+  JParser parser(text);
+  JPtr root = parser.parse();
+
+  auto tr = std::make_unique<Trainer>();
+  for (auto& v : root->at("feed_var_names")->arr)
+    tr->feed_names.push_back(v->s);
+  tr->loss_name = root->at("loss_name")->s;
+  tr->startup_ops =
+      parse_block_ops(root->at("startup_program")->at("blocks")->arr.at(0));
+  tr->main_ops = parse_block_ops(root->at("main_program")->at("blocks")->arr.at(0));
+  return tr.release();
+}
+
+thread_local std::string g_err;
+
+}  // namespace
+
+extern "C" {
+
+const char* ptt_last_error() { return g_err.c_str(); }
+
+void* ptt_create(const char* model_dir) {
+  try {
+    return create(model_dir);
+  } catch (const std::exception& e) {
+    g_err = e.what();
+    return nullptr;
+  }
+}
+
+int ptt_init(void* pv) {
+  try {
+    auto* tr = (Trainer*)pv;
+    Env env;  // params == nullptr marks "startup mode" for initializers
+    for (auto& op : tr->startup_ops)
+      if (!run_train_op(*tr, op, env)) run_op(op, env);
+    // anything a startup op left in env.local is persistent state too
+    for (auto& [n, t] : env.local) tr->scope[n] = std::move(t);
+    return 0;
+  } catch (const std::exception& e) {
+    g_err = e.what();
+    return -1;
+  }
+}
+
+int ptt_step(void* pv, int n, const char** names, const int* dtypes,
+             const int* ndims, const int64_t* dims_concat, const void** datas,
+             float* loss_out) {
+  try {
+    auto* tr = (Trainer*)pv;
+    Env env;
+    env.params = &tr->scope;
+    int64_t doff = 0;
+    for (int i = 0; i < n; ++i) {
+      Tensor t;
+      t.dtype = (DType)dtypes[i];
+      for (int d = 0; d < ndims[i]; ++d)
+        t.dims.push_back(dims_concat[doff + d]);
+      doff += ndims[i];
+      t.alloc();
+      std::memcpy(t.buf.data(), datas[i], t.buf.size());
+      env.local[names[i]] = std::move(t);
+    }
+    for (auto& op : tr->main_ops)
+      if (!run_train_op(*tr, op, env)) run_op(op, env);
+    Tensor l_s;
+    const Tensor& loss = as_f32(need(env, tr->loss_name), l_s);
+    if (loss_out) *loss_out = loss.f()[0];
+    return 0;
+  } catch (const std::exception& e) {
+    g_err = e.what();
+    return -1;
+  }
+}
+
+int ptt_get_var(void* pv, const char* name, int* dtype, int* ndim,
+                const int64_t** dims, const void** data) {
+  auto* tr = (Trainer*)pv;
+  auto it = tr->scope.find(name);
+  if (it == tr->scope.end()) {
+    g_err = std::string("no such variable in scope: ") + name;
+    return -1;
+  }
+  Tensor& t = it->second;
+  *dtype = (int)t.dtype;
+  *ndim = (int)t.dims.size();
+  *dims = t.dims.data();
+  *data = t.buf.data();
+  return 0;
+}
+
+void ptt_destroy(void* p) { delete (Trainer*)p; }
+
+}  // extern "C"

@@ -18,6 +18,8 @@ __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
 _host_events = []  # (name, start, end)
 _enabled = False
 _trace_dir = None
+_last_trace_dir = None  # survives stop_profiler so export can merge
+_trace_t0 = None  # perf_counter at jax trace start (lane alignment origin)
 
 
 class _Event:
@@ -42,15 +44,26 @@ def record_event(name):
 
 
 def reset_profiler():
+    global _last_trace_dir, _trace_t0
     del _host_events[:]
+    _last_trace_dir = None
+    _trace_t0 = None
 
 
 def start_profiler(state="All", trace_dir=None):
-    global _enabled, _trace_dir
+    global _enabled, _trace_dir, _last_trace_dir, _trace_t0
     _enabled = True
+    # a fresh session must not inherit the previous session's device trace
+    # or its time origin (stale merge + mis-shifted host spans otherwise)
+    _last_trace_dir = None
+    _trace_t0 = None
     if trace_dir:
         _trace_dir = trace_dir
+        _last_trace_dir = trace_dir
         jax.profiler.start_trace(trace_dir)
+        # the device trace's ts origin is (approximately) this instant;
+        # host events are shifted to the same origin when exporting
+        _trace_t0 = time.perf_counter()
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
@@ -97,27 +110,65 @@ def _print_summary(sorted_key, profile_path):
         pass
 
 
-def export_chrome_trace(path):
-    """Write recorded host events as a chrome://tracing / Perfetto JSON
-    file (reference tools/timeline.py:1 Timeline._build_chrome_trace).
+_DEVICE_PID_BASE = 100  # keep device pids clear of the host lane's pid 0
 
-    Host rows cover executor ops and user record_event() spans; the DEVICE
-    timeline is the XLA trace jax.profiler writes to the trace_dir passed
-    to start_profiler (open both in Perfetto for the merged picture — the
-    reference merges CUPTI + host events into one proto the same way)."""
+
+def _load_device_trace(trace_dir):
+    """Newest run's Chrome-trace events from a jax.profiler trace_dir
+    (plugins/profile/<run>/<host>.trace.json.gz), pids offset into the
+    device range. Returns [] when no trace was captured."""
+    import glob
+    import gzip
     import json
 
-    events = []
+    runs = sorted(glob.glob(
+        f"{trace_dir}/plugins/profile/*/*.trace.json.gz"))
+    if not runs:
+        return []
+    with gzip.open(runs[-1], "rt") as f:
+        raw = json.load(f).get("traceEvents", [])
+    shifted = []
+    for e in raw:
+        if not isinstance(e, dict) or "pid" not in e:
+            continue
+        e = dict(e)
+        e["pid"] = _DEVICE_PID_BASE + int(e["pid"])
+        shifted.append(e)
+    return shifted
+
+
+def export_chrome_trace(path):
+    """ONE merged chrome://tracing / Perfetto JSON with BOTH lanes — host
+    RecordEvent spans and the XLA device trace (reference
+    tools/timeline.py:36-97, which merges host events with CUPTI device
+    records via device_tracer.cc:44 the same way).
+
+    Alignment: the device trace's timestamps start at ~0 at
+    jax.profiler.start_trace; host events are shifted onto that origin
+    (perf_counter delta from start_profiler). Host rows live under pid 0,
+    device processes keep their own pids offset by 100."""
+    import json
+
+    t0 = _trace_t0 if _trace_t0 is not None else (
+        min((ev.start for ev in _host_events), default=0.0))
+    events = [
+        {"ph": "M", "pid": 0, "name": "process_name",
+         "args": {"name": "paddle_tpu host"}},
+        {"ph": "M", "pid": 0, "name": "process_sort_index",
+         "args": {"sort_index": 0}},
+    ]
     for ev in _host_events:
         events.append({
             "name": ev.name,
             "ph": "X",  # complete event
-            "ts": ev.start * 1e6,
+            "ts": (ev.start - t0) * 1e6,
             "dur": (ev.end - ev.start) * 1e6,
             "pid": 0,
             "tid": "host",
             "cat": "host",
         })
+    if _last_trace_dir:
+        events.extend(_load_device_trace(_last_trace_dir))
     with open(path, "w") as f:
         json.dump({"traceEvents": events,
                    "displayTimeUnit": "ms"}, f)

@@ -104,4 +104,6 @@ def test_timeline_export(tmp_path):
     names = [e["name"] for e in trace["traceEvents"]]
     assert "stage::load" in names
     assert any(n.startswith("op::scale") for n in names)
-    assert all(e["ph"] == "X" and "dur" in e for e in trace["traceEvents"])
+    # host spans are complete events; "M" metadata rows name the lanes
+    assert all("dur" in e for e in trace["traceEvents"] if e["ph"] == "X")
+    assert any(e["ph"] == "X" for e in trace["traceEvents"])

@@ -1,0 +1,88 @@
+"""Native C++ training demo (r3 VERDICT missing #5 / task 9).
+
+Reference parity: paddle/fluid/train/demo/demo_trainer.cc — load a saved
+ProgramDesc (startup + train program incl. backward + sgd ops), init
+parameters natively, run training steps with NO Python in the loop. Here:
+fluid.io.save_train_model writes the JSON IR pair; native/train.cc
+(libpttrain.so) runs startup + fwd+bwd+sgd steps on CPU kernels.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+try:
+    from paddle_tpu.native.train import NativeTrainer
+    _native_err = None
+except Exception as e:  # g++ missing etc.
+    NativeTrainer = None
+    _native_err = e
+
+pytestmark = pytest.mark.skipif(
+    NativeTrainer is None, reason=f"native build unavailable: {_native_err}")
+
+
+def _build_and_save(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    d = str(tmp_path / "train_model")
+    fluid.io.save_train_model(d, ["x", "y"], loss, main, startup)
+    return d, main, startup, loss
+
+
+def test_native_train_converges(tmp_path):
+    d, *_ = _build_and_save(tmp_path)
+    tr = NativeTrainer(d)
+    rs = np.random.RandomState(0)
+    W = rs.randn(4, 1).astype("float32")
+    losses = []
+    for _ in range(60):
+        xv = rs.randn(16, 4).astype("float32")
+        yv = xv @ W
+        losses.append(tr.step({"x": xv, "y": yv}))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+    # the learned weight approaches the generator
+    w = tr.get_var("fc_0.w_0")
+    assert w.shape == (4, 1)
+    np.testing.assert_allclose(w, W, atol=0.15)
+
+
+def test_native_train_matches_python_executor(tmp_path):
+    """Same program, same data, same updates: the C++ loop must track the
+    Python/XLA executor step for step (fp32, same op order)."""
+    d, main, startup, loss = _build_and_save(tmp_path)
+
+    rs = np.random.RandomState(3)
+    W = rs.randn(4, 1).astype("float32")
+    batches = []
+    for _ in range(10):
+        xv = rs.randn(8, 4).astype("float32")
+        batches.append({"x": xv, "y": (xv @ W).astype("float32")})
+
+    tr = NativeTrainer(d)
+    # align initializations: copy the natively-initialized parameters into
+    # the python scope (the two runtimes use different RNG streams)
+    w0, b0 = tr.get_var("fc_0.w_0"), tr.get_var("fc_0.w_1")
+    native_losses = [tr.step(b) for b in batches]
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope.set_var("fc_0.w_0", np.ascontiguousarray(w0))
+        scope.set_var("fc_0.w_1", np.ascontiguousarray(b0))
+        py_losses = [
+            float(np.asarray(exe.run(main, feed=b,
+                                     fetch_list=[loss])[0]).item())
+            for b in batches
+        ]
+    np.testing.assert_allclose(native_losses, py_losses, rtol=2e-4,
+                               atol=1e-5)

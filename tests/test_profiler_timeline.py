@@ -1,0 +1,70 @@
+"""Merged host+device timeline (r3 VERDICT missing #4 / task 6).
+
+Reference parity: tools/timeline.py:36-97 merges host RecordEvents with the
+CUPTI device records (platform/device_tracer.cc:44) into ONE Chrome trace.
+Here the device lane is the XLA trace jax.profiler captures; both lanes land
+in one JSON with a shared time origin.
+"""
+
+import json
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import profiler
+
+
+def test_merged_timeline_has_both_lanes(tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    profiler.reset_profiler()
+    profiler.start_profiler("All", trace_dir=trace_dir)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+        y = fluid.layers.fc(input=x, size=64)
+        loss = fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with profiler.record_event("train_step_span"):
+        for _ in range(3):
+            exe.run(main, feed={"x": np.ones((8, 64), "float32")},
+                    fetch_list=[loss])
+
+    profiler.stop_profiler(profile_path=str(tmp_path / "prof"))
+    out = profiler.export_chrome_trace(str(tmp_path / "merged.json"))
+
+    with open(out) as f:
+        events = json.load(f)["traceEvents"]
+
+    host = [e for e in events if e.get("pid") == 0 and e.get("ph") == "X"]
+    assert any(e["name"] == "train_step_span" for e in host), \
+        "host RecordEvent span missing from the merged trace"
+
+    dev_meta = [e for e in events
+                if e.get("pid", 0) >= 100 and e.get("ph") == "M"]
+    assert dev_meta, "device lane (jax/XLA trace) missing"
+    dev_spans = [e for e in events
+                 if e.get("pid", 0) >= 100 and e.get("ph") == "X"]
+    assert dev_spans, "device lane has no execution spans"
+
+    # shared origin: the host span must overlap the traced window, not sit
+    # seconds away on its own epoch
+    span = next(e for e in host if e["name"] == "train_step_span")
+    dev_end = max(e["ts"] + e.get("dur", 0) for e in dev_spans)
+    assert -1e6 < span["ts"] < dev_end + 5e6, (span["ts"], dev_end)
+
+
+def test_export_without_device_trace_is_host_only(tmp_path):
+    profiler.reset_profiler()
+    profiler._last_trace_dir = None
+    profiler._trace_t0 = None
+    profiler._enabled = True
+    with profiler.record_event("solo"):
+        pass
+    profiler._enabled = False
+    out = profiler.export_chrome_trace(str(tmp_path / "host_only.json"))
+    with open(out) as f:
+        events = json.load(f)["traceEvents"]
+    assert any(e.get("name") == "solo" for e in events)
+    assert all(e.get("pid", 0) < 100 for e in events)
