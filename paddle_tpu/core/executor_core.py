@@ -113,8 +113,6 @@ def _fuse_optimizer_group(ops, start, env, ctx, fused_ids):
 
     Returns the set of fused op ids (empty when no fusion applies).
     """
-    from .. import amp
-
     first_op = ops[start]
 
     def key_attrs(op):
@@ -148,14 +146,14 @@ def _fuse_optimizer_group(ops, start, env, ctx, fused_ids):
                     ok = False  # SelectedRows/ragged/missing: per-op path
         if not ok:
             continue
-        ins = amp.apply_policy(op.type, ins)
         if int(np.prod(ins["Param"][0].shape)) > _FUSE_MAX_NUMEL:
             continue
         group.append(op)
         per_op_ins.append(ins)
     if len(group) < 2:
         return set()
-    # dtype homogeneity per slot (mixed groups would silently upcast)
+    # RAW dtype homogeneity per slot: run_kernel's amp policy then applies
+    # one cast to the concatenated slot, identical to per-op policy casts
     for s in slots:
         d0 = per_op_ins[0][s][0].dtype
         if any(o[s][0].dtype != d0 for o in per_op_ins):
@@ -169,7 +167,9 @@ def _fuse_optimizer_group(ops, start, env, ctx, fused_ids):
         for s in slots
     }
     cat_ins["LearningRate"] = [env_get(env, lr_name)]
-    outs = op_def.fn(ctx, cat_ins, first_op.attrs) or {}
+    # through run_kernel, not op_def.fn: amp policy + op-coverage tracking
+    # apply to the fused call exactly like a per-op call
+    outs = registry.run_kernel(op_def, ctx, cat_ins, first_op.attrs) or {}
     offsets = np.cumsum([0] + sizes)
     for slot, vals in outs.items():
         flat = vals[0] if isinstance(vals, list) else vals

@@ -183,6 +183,7 @@ class Executor:
             tuple(fetch_names),
             tuple(state_names),
             amp.fingerprint(),
+            flags.get("fuse_optimizer_ops"),  # trace-affecting, like amp
         )
         entry = self._compile_cache.get(cache_key) if use_cache else None
         if entry is None:
@@ -279,6 +280,7 @@ class Executor:
             tuple(fetch_names),
             tuple(state_names),
             amp.fingerprint(),
+            flags.get("fuse_optimizer_ops"),
             ("iters", iters),
         )
         entry = self._compile_cache.get(cache_key) if use_cache else None

@@ -23,6 +23,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import amp
+from . import flags
 from .core import executor_core
 from .core.framework import Parameter, Variable, default_main_program
 from .core.lod_tensor import LoDTensor
@@ -152,18 +153,26 @@ class ParallelExecutor:
             return NamedSharding(self._mesh, P("dp"))
         return NamedSharding(self._mesh, P())
 
-    def _feed_sharding(self, value):
+    def _feed_sharding(self, value, leading_steps=False):
         if isinstance(value, SeqTensor):
             return SeqTensor(
                 jax.device_put(value.data, NamedSharding(self._mesh, P("dp"))),
                 jax.device_put(value.lengths, NamedSharding(self._mesh, P("dp"))),
             )
-        return jax.device_put(value, NamedSharding(self._mesh, P("dp")))
+        # iters=K feeds carry a leading [K] step axis; the batch axis to
+        # shard over dp is axis 1 there
+        spec = P(None, "dp") if leading_steps else P("dp")
+        return jax.device_put(value, NamedSharding(self._mesh, spec))
 
     # ------------------------------------------------------------------
-    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True,
+            iters=None):
+        """One data-parallel step over the mesh — or, with `iters=K`, K
+        steps inside ONE jit'd lax.scan dispatch (feeds carry a leading
+        [K] axis, batch sharded over "dp" on axis 1; fetches come back
+        stacked [K, ...]). Same contract as Executor.run(iters=K)."""
         feed = feed if feed is not None else feed_dict
-        if isinstance(feed, list):
+        if isinstance(feed, list) and iters is None:
             # per-device feed list (reference feed_parallel): concatenate
             merged = {}
             for d in feed:
@@ -171,6 +180,13 @@ class ParallelExecutor:
                     arr = np.asarray(v.numpy() if isinstance(v, LoDTensor) else v)
                     merged.setdefault(k, []).append(arr)
             feed = {k: np.concatenate(vs, axis=0) for k, vs in merged.items()}
+        elif isinstance(feed, (list, tuple)) and iters is not None:
+            if iters != len(feed):
+                raise ValueError(
+                    f"iters={iters} but feed has {len(feed)} step dicts")
+            names = set().union(*(f.keys() for f in feed)) if feed else set()
+            feed = {n: np.stack([np.asarray(f[n]) for f in feed], 0)
+                    for n in names}
         feed = feed or {}
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
 
@@ -178,7 +194,8 @@ class ParallelExecutor:
         feed_vals = {}
         for name, value in feed.items():
             tv = executor_core.feed_to_tracevalue(value)
-            feed_vals[name] = self._feed_sharding(tv)
+            feed_vals[name] = self._feed_sharding(
+                tv, leading_steps=iters is not None)
 
         state_names, state_out_names = executor_core.collect_state_names(program, scope)
         cache_key = (
@@ -188,10 +205,21 @@ class ParallelExecutor:
             tuple(fetch_names),
             tuple(state_names),
             amp.fingerprint(),
+            flags.get("fuse_optimizer_ops"),  # trace-affecting, like amp
+            ("iters", iters),
         )
         entry = self._compile_cache.get(cache_key)
         if entry is None:
             step = executor_core.build_step_fn(program, fetch_names, state_out_names)
+            if iters is not None:
+                missing = [n for n in state_out_names
+                           if not scope.has_var(n)]
+                if missing:
+                    raise ValueError(
+                        f"iters > 1 needs every written persistable var in "
+                        f"scope before the scan; missing: {missing}. Run "
+                        f"the startup program first.")
+                step = executor_core.build_multi_step_fn(step, iters)
             compiled = jax.jit(step, donate_argnums=(0,))
             entry = (compiled, state_names, state_out_names)
             self._compile_cache[cache_key] = entry

@@ -131,3 +131,50 @@ def test_collective_ops_single_device_identity():
     xv = jnp.arange(4.0)
     res = registry.run_kernel(opdef, OpContext(), {"X": [xv]}, {})
     np.testing.assert_allclose(np.asarray(res["Out"][0]), np.arange(4.0))
+
+
+def test_parallel_executor_iters_scan():
+    """PE(iters=K): K data-parallel steps in one mesh dispatch must match
+    K sequential PE.run calls (same losses, same final params)."""
+    import paddle_tpu as fluid
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            p = fluid.layers.fc(input=x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=p, label=y))
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    K = 4
+    rs = np.random.RandomState(2)
+    feeds = [{"x": rs.randn(16, 6).astype("float32"),
+              "y": rs.randn(16, 1).astype("float32")} for _ in range(K)]
+
+    main, startup, loss = build()
+    sc1 = fluid.Scope()
+    with fluid.scope_guard(sc1):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                    main_program=main)
+        seq = [float(np.asarray(pe.run([loss.name], feed=f)[0]).mean())
+               for f in feeds]
+        w_seq = np.asarray(fluid.executor._ensure_addressable(
+            sc1.find_var("fc_0.w_0")))
+
+    main2, startup2, loss2 = build()
+    sc2 = fluid.Scope()
+    with fluid.scope_guard(sc2):
+        fluid.Executor(fluid.CPUPlace()).run(startup2)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss2.name,
+                                    main_program=main2)
+        out, = pe.run([loss2.name], feed=feeds, iters=K)
+        scan = np.asarray(out).reshape(-1)
+        w_scan = np.asarray(fluid.executor._ensure_addressable(
+            sc2.find_var("fc_0.w_0")))
+
+    np.testing.assert_allclose(scan, seq, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(w_scan, w_seq, rtol=2e-4, atol=1e-5)
