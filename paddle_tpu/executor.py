@@ -77,6 +77,48 @@ def _program_has_host_ops(program):
     return False
 
 
+def stack_multi_step_feeds(program, feed, iters):
+    """list-of-dicts -> one dict of [K, ...] jnp arrays for an iters=K scan
+    (shared by Executor and ParallelExecutor); a dict is trusted to be
+    pre-stacked (leading axis == iters, checked). Rejects ragged (LoD)
+    feeds and casts to each program var's declared dtype."""
+    import jax.numpy as jnp
+
+    if isinstance(feed, (list, tuple)):
+        names = set().union(*(f.keys() for f in feed)) if feed else set()
+        stacked = {}
+        for n in names:
+            vals = [f[n] for f in feed]
+            if any(isinstance(v, SeqTensor)
+                   or (isinstance(v, LoDTensor) and v.lod())
+                   for v in vals):
+                raise ValueError(
+                    f"iters > 1 does not support ragged (LoD) feeds "
+                    f"({n!r}); pad to dense first")
+            stacked[n] = np.stack([np.asarray(v) for v in vals], 0)
+        feed = stacked
+    vals = {}
+    gb = program.global_block()
+    for name, value in feed.items():
+        var = gb.vars.get(name)
+        if isinstance(value, SeqTensor) or \
+                (isinstance(value, LoDTensor) and value.lod()):
+            raise ValueError(
+                f"iters > 1 does not support ragged (LoD) feeds "
+                f"({name!r}); pad to dense first")
+        tv = value if hasattr(value, "dtype") else np.asarray(value)
+        if np.shape(tv)[0] != iters:
+            raise ValueError(
+                f"feed {name!r} leading axis {np.shape(tv)[0]} != "
+                f"iters {iters} (pre-stacked feeds carry [K, ...])")
+        tv = jnp.asarray(tv)
+        if var is not None and var.dtype is not None \
+                and str(tv.dtype) != var.dtype:
+            tv = tv.astype(var.dtype)
+        vals[name] = tv
+    return vals
+
+
 class Executor:
     def __init__(self, place=None):
         self.place = place if place is not None else TPUPlace(0)
@@ -228,37 +270,7 @@ class Executor:
         return [self._to_host(f) for f in fetches]
 
     def _stack_feeds(self, program, feed, iters):
-        """list-of-dicts -> one dict of [K, ...] arrays; a dict is trusted to
-        be pre-stacked (leading axis == iters, checked)."""
-        import jax.numpy as jnp
-
-        if isinstance(feed, (list, tuple)):
-            names = set().union(*(f.keys() for f in feed)) if feed else set()
-            stacked = {}
-            for n in names:
-                vals = [f[n] for f in feed]
-                if any(isinstance(v, SeqTensor) for v in vals):
-                    raise ValueError(
-                        f"iters > 1 does not support ragged (LoD) feeds "
-                        f"({n!r}); pad to dense first")
-                arr = np.stack([np.asarray(v) for v in vals], 0)
-                stacked[n] = arr
-            feed = stacked
-        vals = {}
-        gb = program.global_block()
-        for name, value in feed.items():
-            var = gb.vars.get(name)
-            tv = value if hasattr(value, "dtype") else np.asarray(value)
-            if np.shape(tv)[0] != iters:
-                raise ValueError(
-                    f"feed {name!r} leading axis {np.shape(tv)[0]} != "
-                    f"iters {iters} (pre-stacked feeds carry [K, ...])")
-            tv = jnp.asarray(tv)
-            if var is not None and var.dtype is not None \
-                    and str(tv.dtype) != var.dtype:
-                tv = tv.astype(var.dtype)
-            vals[name] = tv
-        return vals
+        return stack_multi_step_feeds(program, feed, iters)
 
     def _run_compiled_multi(self, program, scope, feed, fetch_names,
                             use_cache, iters):

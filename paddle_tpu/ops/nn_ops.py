@@ -200,9 +200,8 @@ def batch_norm_op(ctx, ins, attrs):
         # that corner were measured and rejected: running-mean shift -5%,
         # first-sample shift -19% (the shifted stats path can no longer
         # share its read with the normalize path).
-        xc = xf
-        m = jnp.mean(xc, axis=axes)
-        msq = jnp.mean(jnp.square(xc), axis=axes)
+        m = jnp.mean(xf, axis=axes)
+        msq = jnp.mean(jnp.square(xf), axis=axes)
         v = jnp.maximum(msq - jnp.square(m), 0.0)
         mean_out = mean * momentum + m * (1 - momentum)
         var_out = var * momentum + v * (1 - momentum)

@@ -259,12 +259,15 @@ def assign_value_op(ctx, ins, attrs):
 
 @register_op("arg_max")
 def arg_max_op(ctx, ins, attrs):
-    return out(Out=jnp.argmax(first(ins, "X"), axis=attrs.get("axis", -1)).astype(jnp.int64))
+    o = jnp.argmax(first(ins, "X"), axis=attrs.get("axis", -1))
+    # fluid has no 0-d tensors: a rank-1 input reduces to shape {1}
+    return out(Out=(o.reshape(1) if o.ndim == 0 else o).astype(jnp.int64))
 
 
 @register_op("arg_min")
 def arg_min_op(ctx, ins, attrs):
-    return out(Out=jnp.argmin(first(ins, "X"), axis=attrs.get("axis", -1)).astype(jnp.int64))
+    o = jnp.argmin(first(ins, "X"), axis=attrs.get("axis", -1))
+    return out(Out=(o.reshape(1) if o.ndim == 0 else o).astype(jnp.int64))
 
 
 @register_op("argsort")

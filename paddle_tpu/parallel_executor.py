@@ -180,22 +180,27 @@ class ParallelExecutor:
                     arr = np.asarray(v.numpy() if isinstance(v, LoDTensor) else v)
                     merged.setdefault(k, []).append(arr)
             feed = {k: np.concatenate(vs, axis=0) for k, vs in merged.items()}
-        elif isinstance(feed, (list, tuple)) and iters is not None:
-            if iters != len(feed):
-                raise ValueError(
-                    f"iters={iters} but feed has {len(feed)} step dicts")
-            names = set().union(*(f.keys() for f in feed)) if feed else set()
-            feed = {n: np.stack([np.asarray(f[n]) for f in feed], 0)
-                    for n in names}
         feed = feed or {}
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
 
         program, scope = self._program, self._scope
         feed_vals = {}
-        for name, value in feed.items():
-            tv = executor_core.feed_to_tracevalue(value)
-            feed_vals[name] = self._feed_sharding(
-                tv, leading_steps=iters is not None)
+        if iters is not None:
+            if isinstance(feed, (list, tuple)) and iters != len(feed):
+                raise ValueError(
+                    f"iters={iters} but feed has {len(feed)} step dicts")
+            # shared stacking helper: LoD rejection, leading-axis check,
+            # dtype cast — the same contract as Executor.run(iters=K)
+            from .executor import stack_multi_step_feeds
+
+            for name, value in stack_multi_step_feeds(
+                    program, feed, iters).items():
+                feed_vals[name] = self._feed_sharding(
+                    value, leading_steps=True)
+        else:
+            for name, value in feed.items():
+                tv = executor_core.feed_to_tracevalue(value)
+                feed_vals[name] = self._feed_sharding(tv)
 
         state_names, state_out_names = executor_core.collect_state_names(program, scope)
         cache_key = (

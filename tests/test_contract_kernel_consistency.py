@@ -294,8 +294,14 @@ def _out_slots(op_type, attrs):
         return {"Out": 1, "Indices": 1}
     if op_type == "split":
         return {"Out": n}
-    if op_type == "conv2d":
+    if op_type in ("conv2d", "conv2d_transpose", "conv3d"):
         return {"Output": 1}
+    if op_type == "argsort":
+        return {"Out": 1, "Indices": 1}
+    if op_type == "lrn":
+        return {"Out": 1, "MidOut": 1}
+    if op_type == "squared_l2_distance":
+        return {"sub_result": 1, "Out": 1}
     return {"Out": 1}
 
 
@@ -371,6 +377,149 @@ def _kernel_verdict_concrete(op_def, ins_struct, attrs):
     shapes = {s: [tuple(v.shape) if v is not None else None for v in vs]
               for s, vs in outs.items()}
     return True, shapes
+
+
+# ---------------------------------------------------------------------------
+# extended families (r4: full-registry coverage means the fuzz should pin
+# more than the original high-traffic set)
+# ---------------------------------------------------------------------------
+def gen_pad():
+    for _ in range(6):
+        x = rdims(rng.randint(1, 3))
+        p = []
+        for _ in x:
+            p += [rng.randint(0, 2), rng.randint(0, 2)]
+        yield {"X": x}, {"paddings": p, "pad_value": 0.0}, "valid"
+    yield {"X": (2, 3)}, {"paddings": [1, 1]}, "invalid"  # wrong arity
+
+
+def gen_crop():
+    for _ in range(6):
+        x = rdims(rng.randint(1, 3), lo=2)
+        shape = [rng.randint(1, d) for d in x]
+        offs = [rng.randint(0, d - s) for d, s in zip(x, shape)]
+        yield {"X": x}, {"shape": shape, "offsets": offs}, "valid"
+    yield {"X": (3, 3)}, {"shape": [2, 2], "offsets": [2, 2]}, "invalid"
+
+
+def gen_gather():
+    for _ in range(5):
+        x = rdims(rng.randint(1, 3), lo=2)
+        yield {"X": x, "Index": (rng.randint(1, 6),)}, {}, "valid"
+
+
+def gen_one_hot():
+    for _ in range(5):
+        x = rdims(rng.randint(1, 3))
+        yield {"X": x}, {"depth": rng.randint(2, 8)}, "valid"
+
+
+def gen_expand():
+    for _ in range(5):
+        x = rdims(rng.randint(1, 3))
+        times = [rng.randint(1, 3) for _ in x]
+        yield {"X": x}, {"expand_times": times}, "valid"
+    yield {"X": (2, 3)}, {"expand_times": [2]}, "invalid"
+
+
+def gen_arg_extreme():
+    for _ in range(5):
+        x = rdims(rng.randint(1, 3), lo=2)
+        yield {"X": x}, {"axis": rng.randint(-len(x), len(x) - 1)}, "valid"
+    yield {"X": (2, 3)}, {"axis": 5}, "invalid"
+
+
+def gen_argsort():
+    for _ in range(4):
+        yield {"X": rdims(rng.randint(1, 3), lo=2)}, {}, "valid"
+
+
+def gen_maxout():
+    for _ in range(5):
+        n, g, cpg = rng.randint(1, 3), rng.randint(1, 3), rng.randint(1, 3)
+        hw = rng.randint(1, 5)
+        yield ({"X": (n, g * cpg, hw, hw)}, {"groups": g}, "valid")
+    yield {"X": (1, 5, 2, 2)}, {"groups": 2}, "invalid"
+
+
+def gen_lrn():
+    for _ in range(3):
+        yield ({"X": (rng.randint(1, 3), rng.randint(1, 4),
+                      rng.randint(1, 5), rng.randint(1, 5))},
+               {"n": 5, "alpha": 1e-4, "beta": 0.75, "k": 1.0}, "valid")
+    yield {"X": (2, 3)}, {}, "invalid"
+
+
+def gen_pairwise():
+    for _ in range(5):
+        x = rdims(rng.randint(1, 3))
+        yield {"X": x, "Y": x}, {}, "valid"
+    x = rdims(2, lo=2)
+    yield {"X": x, "Y": (x[0] + 1, x[1])}, {}, "invalid"
+
+
+def gen_conv2d_transpose():
+    for _ in range(5):
+        n, ci, co, k = (rng.randint(1, 3), rng.randint(1, 4),
+                        rng.randint(1, 4), rng.randint(1, 3))
+        hw = rng.randint(1, 6)
+        s = rng.randint(1, 2)
+        yield ({"Input": (n, ci, hw, hw), "Filter": (ci, co, k, k)},
+               {"strides": [s, s], "paddings": [0, 0],
+                "dilations": [1, 1], "groups": 1}, "valid")
+    yield ({"Input": (1, 3, 4, 4), "Filter": (2, 4, 3, 3)},
+           {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+            "groups": 1}, "invalid")
+
+
+def gen_conv3d():
+    for _ in range(4):
+        n, ci, co, k = (rng.randint(1, 2), rng.randint(1, 3),
+                        rng.randint(1, 3), rng.randint(1, 2))
+        d = rng.randint(k, k + 3)
+        yield ({"Input": (n, ci, d, d, d), "Filter": (co, ci, k, k, k)},
+               {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                "dilations": [1, 1, 1], "groups": 1}, "valid")
+    yield ({"Input": (1, 3, 4, 4, 4), "Filter": (2, 2, 3, 3, 3)},
+           {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+            "dilations": [1, 1, 1], "groups": 1}, "invalid")
+
+
+def gen_spp():
+    for _ in range(4):
+        p = rng.randint(1, 3)
+        hw = rng.randint(2 ** (p - 1), 2 ** (p - 1) + 5)
+        yield ({"X": (rng.randint(1, 3), rng.randint(1, 3), hw, hw)},
+               {"pyramid_height": p, "pooling_type": "max"}, "valid")
+    yield ({"X": (1, 2, 2, 2)}, {"pyramid_height": 3,
+                                 "pooling_type": "max"}, "invalid")
+
+
+def gen_squared_l2_distance():
+    for _ in range(4):
+        n, d = rdims(2, lo=2, hi=6)
+        yield {"X": (n, d), "Y": (n, d)}, {}, "valid"
+        yield {"X": (n, d), "Y": (1, d)}, {}, "valid"
+    yield {"X": (4, 3), "Y": (2, 3)}, {}, "invalid"
+
+
+FUZZ.update({
+    "pad": gen_pad,
+    "crop": gen_crop,
+    "gather": gen_gather,
+    "one_hot": gen_one_hot,
+    "expand": gen_expand,
+    "arg_max": gen_arg_extreme,
+    "arg_min": gen_arg_extreme,
+    "argsort": gen_argsort,
+    "maxout": gen_maxout,
+    "lrn": gen_lrn,
+    "square_error_cost": gen_pairwise,
+    "conv2d_transpose": gen_conv2d_transpose,
+    "conv3d": gen_conv3d,
+    "spp": gen_spp,
+    "squared_l2_distance": gen_squared_l2_distance,
+})
 
 
 @pytest.mark.parametrize("op_type", sorted(FUZZ))
