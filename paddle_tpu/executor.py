@@ -85,6 +85,9 @@ def stack_multi_step_feeds(program, feed, iters):
     import jax.numpy as jnp
 
     if isinstance(feed, (list, tuple)):
+        if len(feed) != iters:
+            raise ValueError(
+                f"iters={iters} but feed has {len(feed)} step dicts")
         names = set().union(*(f.keys() for f in feed)) if feed else set()
         stacked = {}
         for n in names:

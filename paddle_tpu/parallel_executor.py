@@ -180,24 +180,23 @@ class ParallelExecutor:
                     arr = np.asarray(v.numpy() if isinstance(v, LoDTensor) else v)
                     merged.setdefault(k, []).append(arr)
             feed = {k: np.concatenate(vs, axis=0) for k, vs in merged.items()}
-        feed = feed or {}
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
 
         program, scope = self._program, self._scope
         feed_vals = {}
         if iters is not None:
-            if isinstance(feed, (list, tuple)) and iters != len(feed):
-                raise ValueError(
-                    f"iters={iters} but feed has {len(feed)} step dicts")
-            # shared stacking helper: LoD rejection, leading-axis check,
-            # dtype cast — the same contract as Executor.run(iters=K)
+            # shared stacking helper: list-length and leading-axis checks,
+            # LoD rejection, dtype cast — the same contract as
+            # Executor.run(iters=K); an empty feed list fails there too
             from .executor import stack_multi_step_feeds
 
             for name, value in stack_multi_step_feeds(
-                    program, feed, iters).items():
+                    program, feed if feed is not None else {},
+                    iters).items():
                 feed_vals[name] = self._feed_sharding(
                     value, leading_steps=True)
         else:
+            feed = feed or {}
             for name, value in feed.items():
                 tv = executor_core.feed_to_tracevalue(value)
                 feed_vals[name] = self._feed_sharding(tv)

@@ -1,0 +1,150 @@
+"""Book chapters: fit_a_line, word2vec, recommender_system.
+
+Reference parity: python/paddle/fluid/tests/book/{test_fit_a_line.py,
+test_word2vec.py, test_recommender_system.py} — each chapter builds its
+model through the layer API, trains until the loss drops, and (for
+fit_a_line) round-trips a saved inference model. Synthetic data (the
+datasets' zero-egress fallbacks provide the real readers elsewhere).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_fit_a_line():
+    """Linear regression (book test_fit_a_line.py): y = xW + b via fc,
+    SGD on square_error_cost; then save/load_inference_model round trip."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        avg_cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=y_predict, label=y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    rs = np.random.RandomState(0)
+    W = rs.randn(13, 1).astype("float32")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for _ in range(40):
+            xv = rs.randn(20, 13).astype("float32")
+            yv = (xv @ W + 0.5).astype("float32")
+            l, = exe.run(main, feed={"x": xv, "y": yv},
+                         fetch_list=[avg_cost])
+            losses.append(float(np.asarray(l).mean()))
+        assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            fluid.io.save_inference_model(d, ["x"], [y_predict], exe,
+                                          main_program=main)
+            with fluid.scope_guard(fluid.Scope()):
+                prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+                xv = rs.randn(4, 13).astype("float32")
+                out, = exe.run(prog, feed={feeds[0]: xv},
+                               fetch_list=fetches)
+                assert np.asarray(out).shape == (4, 1)
+
+
+def test_word2vec_ngram():
+    """N-gram LM (book test_word2vec.py): 4 embedded context words concat
+    -> fc -> softmax over the dict; loss must fall below the uniform
+    -log(1/V) baseline."""
+    V, EMB = 40, 16
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = [fluid.layers.data(name=f"w{i}", shape=[1], dtype="int64")
+                 for i in range(4)]
+        label = fluid.layers.data(name="nextw", shape=[1], dtype="int64")
+        embeds = [
+            fluid.layers.embedding(
+                input=w, size=[V, EMB],
+                param_attr=fluid.ParamAttr(name="shared_w"))
+            for w in words
+        ]
+        concat = fluid.layers.concat(input=embeds, axis=1)
+        hidden = fluid.layers.fc(input=concat, size=64, act="sigmoid")
+        predict = fluid.layers.fc(input=hidden, size=V, act="softmax")
+        avg_cost = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=predict, label=label))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    rs = np.random.RandomState(1)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for _ in range(200):
+            ctx = rs.randint(0, V, (32, 4)).astype("int64")
+            nxt = ((ctx[:, 0] + 1) % V)[:, None]  # learnable rule
+            feed = {f"w{i}": ctx[:, i:i + 1] for i in range(4)}
+            feed["nextw"] = nxt
+            l, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+            losses.append(float(np.asarray(l).mean()))
+    uniform = np.log(V)
+    assert losses[-1] < uniform * 0.5, (losses[-1], uniform)
+    assert losses[-1] < losses[0], losses
+
+
+def test_recommender_system():
+    """Two-tower recommender (book test_recommender_system.py): user and
+    item feature towers -> cos_sim -> scaled rating, square error loss."""
+    N_USR, N_MOV, N_CAT = 30, 40, 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        uid = fluid.layers.data(name="user_id", shape=[1], dtype="int64")
+        gender = fluid.layers.data(name="gender_id", shape=[1],
+                                   dtype="int64")
+        usr_emb = fluid.layers.embedding(input=uid, size=[N_USR, 16])
+        usr_g_emb = fluid.layers.embedding(input=gender, size=[2, 8])
+        usr_feat = fluid.layers.fc(
+            input=fluid.layers.concat(
+                input=[fluid.layers.fc(input=usr_emb, size=16),
+                       fluid.layers.fc(input=usr_g_emb, size=8)], axis=1),
+            size=24, act="tanh")
+
+        mid = fluid.layers.data(name="movie_id", shape=[1], dtype="int64")
+        cat = fluid.layers.data(name="category_id", shape=[1],
+                                dtype="int64")
+        mov_emb = fluid.layers.embedding(input=mid, size=[N_MOV, 16])
+        cat_emb = fluid.layers.embedding(input=cat, size=[N_CAT, 8])
+        mov_feat = fluid.layers.fc(
+            input=fluid.layers.concat(
+                input=[fluid.layers.fc(input=mov_emb, size=16),
+                       fluid.layers.fc(input=cat_emb, size=8)], axis=1),
+            size=24, act="tanh")
+
+        sim = fluid.layers.cos_sim(X=usr_feat, Y=mov_feat)
+        rating = fluid.layers.scale(x=sim, scale=5.0)
+        label = fluid.layers.data(name="score", shape=[1], dtype="float32")
+        avg_cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=rating, label=label))
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(avg_cost)
+
+    rs = np.random.RandomState(2)
+    # ground-truth affinity: users like movies with matching parity
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for _ in range(50):
+            u = rs.randint(0, N_USR, (32, 1)).astype("int64")
+            m = rs.randint(0, N_MOV, (32, 1)).astype("int64")
+            feed = {
+                "user_id": u,
+                "gender_id": (u % 2).astype("int64"),
+                "movie_id": m,
+                "category_id": (m % N_CAT).astype("int64"),
+                "score": np.where((u + m) % 2 == 0, 4.5, 1.0
+                                  ).astype("float32"),
+            }
+            l, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+            losses.append(float(np.asarray(l).mean()))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
