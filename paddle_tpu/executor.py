@@ -91,6 +91,10 @@ def stack_multi_step_feeds(program, feed, iters):
         names = set().union(*(f.keys() for f in feed)) if feed else set()
         stacked = {}
         for n in names:
+            if any(n not in f for f in feed):
+                raise ValueError(
+                    f"feed {n!r} missing from some step dicts (every "
+                    f"iters=K step must feed the same names)")
             vals = [f[n] for f in feed]
             if any(isinstance(v, SeqTensor)
                    or (isinstance(v, LoDTensor) and v.lod())
@@ -159,12 +163,8 @@ class Executor:
             program = default_main_program()
         if scope is None:
             scope = global_scope()
-        if isinstance(feed, (list, tuple)):
-            if iters is None:
-                iters = len(feed)
-            elif iters != len(feed):
-                raise ValueError(
-                    f"iters={iters} but feed has {len(feed)} step dicts")
+        if isinstance(feed, (list, tuple)) and iters is None:
+            iters = len(feed)  # length consistency checked in the helper
         feed = feed if feed is not None else {}
         fetch_list = fetch_list or []
         fetch_names = [

@@ -1,0 +1,69 @@
+"""High-level Trainer / Inferencer API (reference book/high-level-api).
+
+Reference parity: python/paddle/fluid/tests/book/high-level-api/
+fit_a_line/test_fit_a_line.py — Trainer(train_func, optimizer_func) with an
+event_handler loop, save_params, then Inferencer(infer_func, param_path)
+serving predictions from the saved parameters.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _infer_func():
+    x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+    return fluid.layers.fc(input=x, size=1, act=None,
+                           param_attr=fluid.ParamAttr(name="w"),
+                           bias_attr=fluid.ParamAttr(name="b"))
+
+
+def _train_func():
+    y_predict = _infer_func()
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    return fluid.layers.mean(
+        fluid.layers.square_error_cost(input=y_predict, label=y))
+
+
+def test_trainer_event_loop_and_inferencer(tmp_path):
+    rs = np.random.RandomState(0)
+    W = rs.randn(6, 1).astype("float32")
+
+    def reader():
+        for _ in range(8):
+            x = rs.randn(16, 6).astype("float32")
+            yield [(x[i], (x[i] @ W).astype("float32")) for i in range(16)]
+
+    events = {"begin_epoch": 0, "end_epoch": 0, "steps": 0, "losses": []}
+
+    def handler(event):
+        if isinstance(event, fluid.BeginEpochEvent):
+            events["begin_epoch"] += 1
+        elif isinstance(event, fluid.EndEpochEvent):
+            events["end_epoch"] += 1
+        elif isinstance(event, fluid.EndStepEvent):
+            events["steps"] += 1
+            events["losses"].append(float(np.asarray(event.metrics[0]).mean()))
+
+    trainer = fluid.Trainer(train_func=_train_func,
+                            optimizer_func=lambda: fluid.optimizer.SGD(
+                                learning_rate=0.05),
+                            place=fluid.CPUPlace())
+    trainer.train(num_epochs=8, event_handler=handler,
+                  reader=reader, feed_order=["x", "y"])
+
+    assert events["begin_epoch"] == 8 and events["end_epoch"] == 8
+    assert events["steps"] == 8 * 8
+    assert events["losses"][-1] < events["losses"][0], events["losses"][:3]
+
+    params_dir = str(tmp_path / "params")
+    trainer.save_params(params_dir)
+
+    infer = fluid.Inferencer(infer_func=_infer_func, param_path=params_dir,
+                             place=fluid.CPUPlace())
+    xv = rs.randn(5, 6).astype("float32")
+    out = infer.infer({"x": xv})
+    got = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+    assert got.shape == (5, 1)
+    # the trained weights should roughly reproduce the generator
+    np.testing.assert_allclose(got, xv @ W, atol=0.5)
