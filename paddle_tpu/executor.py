@@ -68,6 +68,19 @@ def fetch_var(name, scope=None, return_numpy=True):
     return v
 
 
+_debug_nans_applied = [None]
+
+
+def _apply_debug_nans():
+    """Sync the debug_nans flag into jax config (cheap no-op when
+    unchanged); FLAGS_debug_nans can flip between runs like the
+    reference's runtime gflags."""
+    want = flags.get("debug_nans")
+    if _debug_nans_applied[0] != want:
+        jax.config.update("jax_debug_nans", bool(want))
+        _debug_nans_applied[0] = want
+
+
 def _program_has_host_ops(program):
     for block in program.blocks:
         for op in block.ops:
@@ -171,6 +184,7 @@ class Executor:
             v.name if isinstance(v, Variable) else str(v) for v in fetch_list
         ]
 
+        _apply_debug_nans()
         with self._device_scope():
             if iters is not None:
                 # ANY explicit iters (including 1) means "feeds carry a
@@ -229,11 +243,17 @@ class Executor:
             tuple(state_names),
             amp.fingerprint(),
             flags.get("fuse_optimizer_ops"),  # trace-affecting, like amp
+            flags.get("debug_nans"),  # changes donation (see below)
         )
         entry = self._compile_cache.get(cache_key) if use_cache else None
         if entry is None:
             step = executor_core.build_step_fn(program, fetch_names, state_out_names)
-            compiled = executor_core.compile_step_fn(step, donate_state=True)
+            # under debug_nans the trap fires INSIDE compiled() before the
+            # scope write-back; donated buffers would already be deleted,
+            # wrecking both the scope and jax's op-by-op re-run — so trade
+            # the in-place update away while the sanitizer is on
+            compiled = executor_core.compile_step_fn(
+                step, donate_state=not flags.get("debug_nans"))
             entry = (compiled, state_names, state_out_names)
             if use_cache:
                 self._compile_cache[cache_key] = entry
@@ -296,6 +316,7 @@ class Executor:
             tuple(state_names),
             amp.fingerprint(),
             flags.get("fuse_optimizer_ops"),
+            flags.get("debug_nans"),
             ("iters", iters),
         )
         entry = self._compile_cache.get(cache_key) if use_cache else None
@@ -303,7 +324,8 @@ class Executor:
             step = executor_core.build_step_fn(
                 program, fetch_names, state_out_names)
             multi = executor_core.build_multi_step_fn(step, iters)
-            compiled = executor_core.compile_step_fn(multi, donate_state=True)
+            compiled = executor_core.compile_step_fn(
+                multi, donate_state=not flags.get("debug_nans"))
             entry = (compiled, state_names, state_out_names)
             if use_cache:
                 self._compile_cache[cache_key] = entry

@@ -105,6 +105,13 @@ define("check_nan_inf", bool, False,
        "contains NaN/Inf, naming the variable (reference executor.cc:343).")
 define("benchmark", bool, False,
        "Synchronize and time each executor run (reference FLAGS_benchmark).")
+define("debug_nans", bool, False,
+       "Trap the first NaN-producing computation (the TPU-native analogue "
+       "of the legacy trainer's feenableexcept FPE trapping, "
+       "TrainerMain.cpp:47): maps to jax_debug_nans, which re-runs the "
+       "offending jitted computation op-by-op and raises at the exact "
+       "primitive. Heavier than check_nan_inf's step-boundary scan; use "
+       "to localize, not in production runs.")
 define("fuse_optimizer_ops", bool, False,
        "Batch identical small-parameter optimizer updates (sgd/momentum) "
        "into one kernel call over concatenated flats. Default OFF: on the "

@@ -29,7 +29,7 @@ from .core.framework import Parameter, Variable, default_main_program
 from .core.lod_tensor import LoDTensor
 from .core.registry import SeqTensor
 from .core.scope import global_scope
-from .executor import as_numpy
+from .executor import as_numpy, _apply_debug_nans
 
 __all__ = ["ParallelExecutor", "ExecutionStrategy", "BuildStrategy"]
 
@@ -171,6 +171,7 @@ class ParallelExecutor:
         steps inside ONE jit'd lax.scan dispatch (feeds carry a leading
         [K] axis, batch sharded over "dp" on axis 1; fetches come back
         stacked [K, ...]). Same contract as Executor.run(iters=K)."""
+        _apply_debug_nans()
         feed = feed if feed is not None else feed_dict
         if isinstance(feed, list) and iters is None:
             # per-device feed list (reference feed_parallel): concatenate
@@ -210,6 +211,7 @@ class ParallelExecutor:
             tuple(state_names),
             amp.fingerprint(),
             flags.get("fuse_optimizer_ops"),  # trace-affecting, like amp
+            flags.get("debug_nans"),  # changes donation, like Executor
             ("iters", iters),
         )
         entry = self._compile_cache.get(cache_key)
@@ -224,7 +226,8 @@ class ParallelExecutor:
                         f"scope before the scan; missing: {missing}. Run "
                         f"the startup program first.")
                 step = executor_core.build_multi_step_fn(step, iters)
-            compiled = jax.jit(step, donate_argnums=(0,))
+            donate = () if flags.get("debug_nans") else (0,)
+            compiled = jax.jit(step, donate_argnums=donate)
             entry = (compiled, state_names, state_out_names)
             self._compile_cache[cache_key] = entry
         compiled, state_names, state_out_names = entry

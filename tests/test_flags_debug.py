@@ -107,3 +107,54 @@ def test_timeline_export(tmp_path):
     # host spans are complete events; "M" metadata rows name the lanes
     assert all("dur" in e for e in trace["traceEvents"] if e["ph"] == "X")
     assert any(e["ph"] == "X" for e in trace["traceEvents"])
+
+
+def test_debug_nans_traps_at_the_op(tmp_path):
+    """FLAGS_debug_nans (the feenableexcept FPE-trap analogue,
+    TrainerMain.cpp:47): the first NaN-producing computation raises,
+    instead of the NaN flowing to the step boundary."""
+    import paddle_tpu as fluid
+    from paddle_tpu import flags as fl
+
+    prog, sp = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sp):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.log(x)  # log(-1) -> NaN
+    exe = fluid.Executor(fluid.CPUPlace())
+    bad = np.array([[-1.0, 2.0]], np.float32)
+    with fl.flag_guard(debug_nans=True):
+        with pytest.raises(FloatingPointError):
+            exe.run(prog, feed={"x": bad}, fetch_list=[y])
+    # flag off: NaN flows through silently (reference default behavior)
+    out, = exe.run(prog, feed={"x": bad}, fetch_list=[y])
+    assert np.isnan(np.asarray(out)).any()
+
+
+def test_debug_nans_with_persistable_state_keeps_scope_alive():
+    """The trap must not strand the scope on donated (deleted) buffers: a
+    real training program (persistable params) hits a NaN under
+    FLAGS_debug_nans, raises with op blame, and the SAME scope still
+    trains afterwards."""
+    import paddle_tpu as fluid
+    from paddle_tpu import flags as fl
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        h = fluid.layers.fc(input=x, size=2)
+        y = fluid.layers.mean(fluid.layers.log(h))  # log of +/- values
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(y)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with fl.flag_guard(debug_nans=True):
+            with pytest.raises(FloatingPointError):
+                # all-negative activations force log() NaNs
+                exe.run(main, feed={"x": -np.ones((4, 3), np.float32) * 100},
+                        fetch_list=[y])
+        # scope survived: params still usable, training proceeds
+        out, = exe.run(main, feed={"x": np.abs(
+            np.random.RandomState(0).randn(4, 3)).astype("float32") + 5},
+            fetch_list=[y])
+        assert np.isfinite(np.asarray(out)).all()
