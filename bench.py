@@ -56,7 +56,11 @@ WARMUP_CALLS = 2
 CALLS = int(os.environ.get("BENCH_CALLS", 5))
 BASELINE_IMG_S = 81.69
 USE_AMP = os.environ.get("BENCH_AMP", "1") != "0"
-PIPELINE_STEPS = int(os.environ.get("BENCH_PIPELINE_STEPS", 6))
+# renamed from BENCH_PIPELINE_STEPS (r4 silently changed the unit from
+# steps to chunks; the name now matches). The old var is honored verbatim —
+# it already meant chunks at r4, each chunk = PIPELINE_CHUNK steps.
+PIPELINE_CHUNKS = int(os.environ.get(
+    "BENCH_PIPELINE_CHUNKS", os.environ.get("BENCH_PIPELINE_STEPS", 6)))
 
 
 def _build_pipeline_program(fluid):
@@ -93,7 +97,7 @@ def measure_pipeline(fluid):
     # chose for its donated state outputs (measured: a second ~27 s compile
     # lands on the first post-compile call; steady state from call 3)
     warm_chunks = 2
-    timed_chunks = max(1, PIPELINE_STEPS)
+    timed_chunks = max(1, PIPELINE_CHUNKS)
 
     path = "/tmp/bench_pipeline.recordio"
     if os.path.exists(path):

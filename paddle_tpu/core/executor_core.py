@@ -125,11 +125,30 @@ def _fuse_optimizer_group(ops, start, env, ctx, fused_ids):
     lr_name = (first_op.inputs.get("LearningRate") or [None])[0]
     slots = [s for s in first_op.inputs if s != "LearningRate"]
     group, per_op_ins = [], []
+    # Hazards vs ops between `start` and the candidate that do NOT join the
+    # group (the fused kernel runs at the first member's position):
+    #  - RAW: a member whose input is (re)written by an intervening op
+    #    would read a stale value inside the fused call;
+    #  - WAR: an intervening op that READS a name the member writes would
+    #    observe the post-update value (the fused call commits early).
+    # Either way the candidate stays on the per-op path.
+    written_between, read_between = set(), set()
+
+    def skip(op):
+        written_between.update(op.output_arg_names())
+        read_between.update(op.input_arg_names())
+
     for op in ops[start:]:
         if id(op) in fused_ids or op.type != first_op.type:
+            skip(op)
             continue
         if key_attrs(op) != a0 or \
                 (op.inputs.get("LearningRate") or [None])[0] != lr_name:
+            skip(op)
+            continue
+        if any(n in written_between for n in op.input_arg_names()) or \
+                any(n in read_between for n in op.output_arg_names()):
+            skip(op)
             continue
         ins = {}
         ok = True
@@ -145,11 +164,17 @@ def _fuse_optimizer_group(ops, start, env, ctx, fused_ids):
                         or not hasattr(v, "dtype"):
                     ok = False  # SelectedRows/ragged/missing: per-op path
         if not ok:
+            skip(op)
             continue
         if int(np.prod(ins["Param"][0].shape)) > _FUSE_MAX_NUMEL:
+            skip(op)
             continue
         group.append(op)
         per_op_ins.append(ins)
+        # members write too (Param/accumulators): a later candidate reading
+        # one of these (same Param updated twice) must stay per-op — inside
+        # the fused call it would read the pre-update value
+        written_between.update(op.output_arg_names())
     if len(group) < 2:
         return set()
     # RAW dtype homogeneity per slot: run_kernel's amp policy then applies
@@ -337,24 +362,34 @@ def build_multi_step_fn(step, iters):
     is amortized by K. Feeds carry a leading [iters] axis; fetches come back
     stacked the same way.
 
-    signature: multi(mut_state, const_state, stacked_feeds, rng)
+    signature: multi(mut_state, const_state, stacked_feeds, (base_key, step0))
                -> (stacked_fetches, new_mut)
+
+    Step i draws rng = fold_in(base_key, step0 + i) — the SAME stream the
+    sequential per-call path uses (Executor._rng_for), so stochastic
+    programs (dropout, random_crop) reproduce K sequential runs exactly.
+    step0 must be a traced int32 array (a python int would bake into the
+    compiled computation and force a recompile per call).
     """
 
     def multi(mut_state, const_state, stacked_feeds, rng):
-        def body(carry, feeds):
-            st, r = carry
-            r, sub = jax.random.split(r)
+        base_key, step0 = rng
+
+        def body(st, xs):
+            i, feeds = xs
+            sub = jax.random.fold_in(base_key, step0 + i)
             fetches, new_mut = step(st, const_state, feeds, sub)
             # carry structure must be invariant across iterations: state the
             # step writes replaces the carried entry; state it only reads
             # rides through unchanged. Written-but-never-carried names are
             # rejected up front by the Executor (see run(iters=...)).
             st = {n: new_mut.get(n, v) for n, v in st.items()}
-            return (st, r), fetches
+            return st, fetches
 
-        (st, _), fetches = jax.lax.scan(
-            body, (mut_state, rng), stacked_feeds, length=iters)
+        st, fetches = jax.lax.scan(
+            body, mut_state,
+            (jnp.arange(iters, dtype=jnp.int32), stacked_feeds),
+            length=iters)
         return fetches, st
 
     return multi

@@ -11,6 +11,7 @@ import time
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from . import amp
 from . import flags
@@ -127,6 +128,10 @@ def stack_multi_step_feeds(program, feed, iters):
                 f"iters > 1 does not support ragged (LoD) feeds "
                 f"({name!r}); pad to dense first")
         tv = value if hasattr(value, "dtype") else np.asarray(value)
+        if len(np.shape(tv)) == 0:
+            raise ValueError(
+                f"feed {name!r} is a scalar; iters > 1 feeds must be "
+                f"pre-stacked with a leading [K={iters}] axis")
         if np.shape(tv)[0] != iters:
             raise ValueError(
                 f"feed {name!r} leading axis {np.shape(tv)[0]} != "
@@ -338,9 +343,13 @@ class Executor:
             if isinstance(v, LoDTensor):
                 v = executor_core.feed_to_tracevalue(v)
             (mut_state if n in out_set else const_state)[n] = v
-        rng = self._rng_for(program)
         key = id(program)
-        self._step_counter[key] = self._step_counter.get(key, 0) + iters - 1
+        step0 = self._step_counter.get(key, 0)
+        self._step_counter[key] = step0 + iters
+        # (base, step0) so step i folds to the sequential stream's key;
+        # step0 rides as a traced array to keep the compile cache hot
+        rng = (jax.random.PRNGKey(program.random_seed),
+               jnp.asarray(step0, jnp.int32))
         fetches, new_mut = compiled(mut_state, const_state, feed_vals, rng)
         for n, v in new_mut.items():
             scope.set_var(n, v)

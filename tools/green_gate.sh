@@ -1,0 +1,15 @@
+#!/bin/bash
+# Mechanical green-suite gate (r4 VERDICT next-round #1): run before EVERY
+# snapshot/milestone commit. Exits nonzero on any fast-suite failure, so a
+# commit produced through this gate cannot ship a red suite.
+set -u
+cd "$(dirname "$0")/.."
+out=$(python -m pytest tests/ -m "not slow" -q --no-header 2>&1)
+rc=$?
+echo "$out" | tail -2
+if [ $rc -ne 0 ]; then
+    echo "GATE: FAST SUITE RED — do not commit" >&2
+    echo "$out" | grep -E "^FAILED|^ERROR" >&2
+    exit 1
+fi
+echo "GATE: green"
