@@ -104,15 +104,37 @@ def _append_backward_ops(block, target_names, no_grad, grad_map, checkpoint_segm
         if not wants:
             continue
 
-        # assign grad var names; dedup repeated contributions with sum ops
-        pending_sums = []  # (canonical, [parts])
+        # assign grad var names; dedup repeated contributions with sum ops.
+        # In-place vars (read AND written by this op, e.g. a While's carried
+        # state) REPLACE instead of sum: the existing grad_map entry is the
+        # grad w.r.t. the post-op value, which this op already consumed via
+        # gout — summing it with the new pre-op grad would double-count.
+        inplace = set(op.output_arg_names())
+        pre_seen = set()  # in-place vars already assigned a @PRE by THIS op
+        pending_sums = []  # (out_name, [parts])
         for slot, outs in gin.items():
             names = op.inputs[slot]
             for i, o in enumerate(outs):
                 if o is None:
                     v = names[i]
                     canonical = grad_var_name(v)
-                    if v in grad_map:
+                    if v in grad_map and v in inplace and v not in pre_seen:
+                        # first occurrence: the old entry (grad w.r.t. the
+                        # post-op value) was consumed via gout — REPLACE
+                        fresh = unique_name.generate(canonical + "@PRE")
+                        outs[i] = fresh
+                        grad_map[v] = fresh
+                        pre_seen.add(v)
+                    elif v in grad_map and v in pre_seen:
+                        # same op reads v through another slot too: its
+                        # cotangents still SUM — into a fresh name, since
+                        # `canonical` may be the live post-op grad
+                        fresh = unique_name.generate(canonical + "@PRE")
+                        total = unique_name.generate(canonical + "@PRE")
+                        outs[i] = fresh
+                        pending_sums.append((total, [grad_map[v], fresh]))
+                        grad_map[v] = total
+                    elif v in grad_map:
                         fresh = unique_name.generate(canonical + "@RENAME")
                         outs[i] = fresh
                         pending_sums.append((canonical, [grad_map[v], fresh]))

@@ -179,23 +179,33 @@ class BlockGuard:
 
 
 class While:
-    """reference control_flow.py:608 — lowers to lax.while_loop."""
+    """reference control_flow.py:608 — lowers to lax.while_loop.
+
+    `max_trip_count` (TPU extension, no reference equivalent): an upper
+    bound on loop trips. Required iff the loop is trained through —
+    `lax.while_loop` is not reverse-differentiable, so while_grad replays
+    the loop as a bounded masked `lax.scan` of max_trip_count iterations
+    (the XLA-idiomatic answer to while_op.cc:95's step-scope replay).
+    Forward-only loops don't need it."""
 
     BEFORE_WHILE_BLOCK = 0
     IN_WHILE_BLOCK = 1
     AFTER_WHILE_BLOCK = 2
 
-    def __init__(self, cond, name=None):
+    def __init__(self, cond, name=None, max_trip_count=None):
         self.helper = LayerHelper("while", name=name)
         self.status = While.BEFORE_WHILE_BLOCK
         if not isinstance(cond, Variable):
             raise TypeError("condition should be a variable")
         self.cond_var = cond
+        self.max_trip_count = max_trip_count
 
     def block(self):
         return WhileGuard(self)
 
     def complete(self, sub_block):
+        from .. import unique_name
+
         main_program = self.helper.main_program
         parent_block = main_program.block(sub_block.parent_idx)
         x_names = set()
@@ -204,11 +214,52 @@ class While:
         inner = set()
         for op in sub_block.ops:
             inner.update(op.output_arg_names())
+
+        # Out: vars the loop body writes that live in the parent scope —
+        # the loop's carried state (reference while_op lists these too).
+        # X keeps ALL parent-visible reads, including read-AND-written
+        # carried vars: their INITIAL values are loop inputs, which is what
+        # makes gradients through the loop expressible at the IR level.
+        out_names = sorted(
+            n for n in inner if parent_block.has_var_recursive(n))
+        in_names = sorted(
+            n for n in x_names if parent_block.has_var_recursive(n))
+        # A float var the loop REWRITES is no longer the constant its
+        # initializer created: constant-initializer layers set
+        # stop_gradient=True on their out, which would kill the backward
+        # reach at every loop accumulator. Only initializer-produced flags
+        # are undone — an explicit user stop_gradient on a computed var is
+        # respected (its parent-block producer is not an initializer).
+        const_init_types = {
+            "fill_constant", "fill_constant_batch_size_like",
+            "fill_zeros_like", "uniform_random", "gaussian_random",
+        }
+        producer = {}
+        for p_op in parent_block.ops:
+            for n in p_op.output_arg_names():
+                producer[n] = p_op.type
+        for n in out_names:
+            v = parent_block.var_recursive(n)
+            if v.dtype and "float" in str(v.dtype) \
+                    and producer.get(n) in const_init_types:
+                v.stop_gradient = False
+        # one snapshot var per Out: while_op saves the pre-loop value there
+        # so while_grad can replay the trajectory (the reference keeps
+        # step scopes instead, while_op.cc:35 StepScopes)
+        init_names = []
+        for n in out_names:
+            snap = unique_name.generate(n + "@WHILE_INIT")
+            v = parent_block.var_recursive(n)
+            parent_block.create_var(name=snap, shape=v.shape, dtype=v.dtype)
+            init_names.append(snap)
+        attrs = {"sub_block": sub_block}
+        if self.max_trip_count is not None:
+            attrs["max_trip_count"] = int(self.max_trip_count)
         parent_block.append_op(
             "while",
-            {"X": sorted(x_names - inner), "Condition": [self.cond_var]},
-            {"Out": [], "StepScopes": []},
-            {"sub_block": sub_block},
+            {"X": in_names, "Condition": [self.cond_var]},
+            {"Out": out_names, "InitStates": init_names, "StepScopes": []},
+            attrs,
         )
 
 

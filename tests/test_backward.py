@@ -69,3 +69,27 @@ def test_stop_gradient_blocks_backprop():
     grad_names = {p.name for p, g in params_grads}
     # first fc's params get no grads (cut by stop_gradient)
     assert len(params_grads) == 2
+
+
+def test_inplace_multi_slot_grad_sums_within_op():
+    """An op that reads the SAME in-place var through several input slots
+    must still sum those slots' cotangents; only the pre-existing post-op
+    grad is replaced (r5 review finding: REPLACE must not drop slot 1).
+    y = a + a written back into a => dloss/dx = d(mean(2*scale(x)))/dx."""
+    import paddle_tpu as fluid
+    from paddle_tpu import backward
+    from paddle_tpu.core.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        a = fluid.layers.scale(x, scale=1.0)
+        fluid.layers.sums([a, a], out=a)  # in-place: a = a + a
+        loss = fluid.layers.mean(a)
+        g, = backward.calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    gv, = exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
+                  fetch_list=[g])
+    np.testing.assert_allclose(np.asarray(gv), np.full((1, 4), 0.5),
+                               rtol=1e-6)
