@@ -30,7 +30,8 @@ from .core.framework import (
 )
 from .core.places import CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, is_compiled_with_tpu
 from .core.scope import Scope, global_scope, scope_guard
-from .core.lod_tensor import LoDTensor, create_lod_tensor, create_random_int_lodtensor
+from .core.lod_tensor import (LoDTensor, create_bucketed_seq_tensor,
+                              create_lod_tensor, create_random_int_lodtensor)
 from .executor import Executor, fetch_var
 from .parallel_executor import ParallelExecutor, ExecutionStrategy, BuildStrategy
 from . import layers
@@ -108,6 +109,7 @@ __all__ = [
     "CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace",
     "Scope", "global_scope", "scope_guard",
     "LoDTensor", "Tensor", "create_lod_tensor", "create_random_int_lodtensor",
+    "create_bucketed_seq_tensor",
     "Executor", "fetch_var", "ParallelExecutor", "ExecutionStrategy", "BuildStrategy",
     "layers", "nets", "ops", "initializer", "regularizer", "clip",
     "metrics", "evaluator", "profiler", "io", "debugger",

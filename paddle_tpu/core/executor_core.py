@@ -353,7 +353,60 @@ def compile_step_fn(step, donate_state=True):
     return jax.jit(step, donate_argnums=(0,) if donate_state else ())
 
 
-def build_multi_step_fn(step, iters):
+def collect_ema_states(program, state_out_names, fetch_names=()):
+    """{var_name: momentum} for batch-norm running stats that are PURE EMA
+    recurrences of this (training) program: written only as a batch_norm's
+    MeanOut/VarianceOut, read only as the SAME op's Mean/Variance input,
+    and not fetched. These can leave the multi-step scan carry (the carry's
+    back-edge copies cost ~2 ms/step on ResNet-50, docs/perf_r04.md) and be
+    reconstructed exactly after the scan — r_{k+1} = m r_k + (1-m) s_k is a
+    linear fold, so r_K = m^K r_0 + Σ m^{K-1-i} (o_i - m r_0) where o_i is
+    the step's output against the CONSTANT initial value r_0."""
+    candidates = {}
+    gb = program.global_block()
+    for op in gb.ops:
+        if op.type != "batch_norm" or op.attrs.get("is_test", False):
+            continue
+        momentum = float(op.attrs.get("momentum", 0.9))
+        for in_slot, out_slot in (("Mean", "MeanOut"),
+                                  ("Variance", "VarianceOut")):
+            ins = op.inputs.get(in_slot) or []
+            outs = op.outputs.get(out_slot) or []
+            if ins and outs and ins[0] == outs[0] and ins[0]:
+                candidates[ins[0]] = (momentum, op)
+    if not candidates:
+        return {}
+    fetched = set(fetch_names)
+    reads, writes = {}, {}
+    for op in gb.ops:
+        for n in op.input_arg_names():
+            reads.setdefault(n, []).append(op)
+        for n in op.output_arg_names():
+            writes.setdefault(n, []).append(op)
+    out_set = set(state_out_names)
+    ema = {}
+    for n, (momentum, owner) in candidates.items():
+        if n not in out_set or n in fetched:
+            continue
+
+        def harmless(o):
+            # batch_norm_grad receives the running stats because the
+            # default vjp maker forwards every forward input, but its
+            # cotangents don't depend on them: MeanOut/VarianceOut are
+            # stop-gradient outputs, and the training branch uses BATCH
+            # statistics for normalization
+            return o is owner or (o.type == "batch_norm_grad"
+                                  and not o.attrs.get("is_test", False))
+
+        if any(not harmless(o) for o in reads.get(n, [])):
+            continue  # another op consumes the running stat: keep carried
+        if any(o is not owner for o in writes.get(n, [])):
+            continue
+        ema[n] = momentum
+    return ema
+
+
+def build_multi_step_fn(step, iters, ema=None):
     """Wrap a step function in a lax.scan over `iters` pre-stacked feeds.
 
     One XLA dispatch then covers `iters` training steps — the host-loop
@@ -372,24 +425,46 @@ def build_multi_step_fn(step, iters):
     compiled computation and force a recompile per call).
     """
 
+    ema = ema or {}
+
     def multi(mut_state, const_state, stacked_feeds, rng):
         base_key, step0 = rng
+        # EMA sinks (collect_ema_states) ride OUTSIDE the carry: each step
+        # sees the constant initial value r_0 and its per-step output is
+        # stacked as a scan Y; the exact K-step fold happens after the scan
+        ema_r0 = {n: mut_state[n] for n in ema if n in mut_state}
+        carry0 = {n: v for n, v in mut_state.items() if n not in ema_r0}
 
         def body(st, xs):
             i, feeds = xs
             sub = jax.random.fold_in(base_key, step0 + i)
-            fetches, new_mut = step(st, const_state, feeds, sub)
+            full = dict(st)
+            full.update(ema_r0)
+            fetches, new_mut = step(full, const_state, feeds, sub)
             # carry structure must be invariant across iterations: state the
             # step writes replaces the carried entry; state it only reads
             # rides through unchanged. Written-but-never-carried names are
             # rejected up front by the Executor (see run(iters=...)).
             st = {n: new_mut.get(n, v) for n, v in st.items()}
-            return st, fetches
+            ys = {n: new_mut[n] for n in ema_r0 if n in new_mut}
+            return st, (fetches, ys)
 
-        st, fetches = jax.lax.scan(
-            body, mut_state,
+        st, (fetches, ema_ys) = jax.lax.scan(
+            body, carry0,
             (jnp.arange(iters, dtype=jnp.int32), stacked_feeds),
             length=iters)
+        # exact reconstruction: o_i = m r_0 + (1-m) s_i was computed against
+        # the constant r_0, and the true fold is linear:
+        #   r_K = m^K r_0 + Σ_i m^(K-1-i) (o_i - m r_0)
+        for n, o_stack in ema_ys.items():
+            m = jnp.asarray(ema[n], jnp.float32)
+            r0 = ema_r0[n].astype(jnp.float32)
+            w = jnp.power(m, jnp.arange(iters - 1, -1, -1, dtype=jnp.float32))
+            contrib = jnp.tensordot(
+                w, o_stack.astype(jnp.float32) - m * r0[None], axes=1)
+            rK = jnp.power(m, iters) * r0 + contrib
+            st = dict(st)
+            st[n] = rK.astype(ema_r0[n].dtype)
         return fetches, st
 
     return multi

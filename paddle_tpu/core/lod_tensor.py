@@ -110,6 +110,40 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
     return t
 
 
+def create_bucketed_seq_tensor(seqs, bucket, place=None, dtype="int64",
+                               pad_value=0):
+    """LoD -> dense bridge for compile-stable sequence feeding (r4 VERDICT
+    task 3): concatenate variable-length sequences and TAIL-PAD the flat
+    data up to the next multiple of `bucket` tokens. The result is a
+    SeqTensor whose data shape is a bucket multiple — batches padded to the
+    same bucket compile ONCE and can ride Executor.run(iters=K) — while
+    lengths stay exact: every lod_aware kernel masks via
+    SeqTensor.segment_ids()/token_mask(), which classify the tail rows as
+    padding, so the math matches the unpadded feed.
+
+    seqs: list of per-sequence 1-D/2-D arrays (a batch). bucket: pad total
+    tokens up to a multiple of this. Returns a SeqTensor feedable wherever
+    a LoDTensor feed is accepted.
+    """
+    import jax.numpy as jnp
+
+    from .registry import SeqTensor
+
+    arrs = [np.asarray(s, dtype=dtype) for s in seqs]
+    arrs = [a.reshape(-1, 1) if a.ndim == 1 else a for a in arrs]
+    lengths = np.asarray([a.shape[0] for a in arrs], np.int32)
+    flat = np.concatenate(arrs, axis=0) if arrs else \
+        np.zeros((0, 1), dtype=dtype)
+    total = flat.shape[0]
+    bucket = max(1, int(bucket))
+    padded_total = -(-total // bucket) * bucket
+    if padded_total > total:
+        pad = np.full((padded_total - total,) + flat.shape[1:], pad_value,
+                      dtype=flat.dtype)
+        flat = np.concatenate([flat, pad], axis=0)
+    return SeqTensor(jnp.asarray(flat), jnp.asarray(lengths))
+
+
 def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low, high):
     total = sum(recursive_seq_lens[-1])
     shape = [total] + list(base_shape)

@@ -167,24 +167,29 @@ def _conv2d(ctx):
     w = ctx.input_dim("Filter")
     if x is None or w is None:
         return
-    ctx.enforce(len(x) == 4, f"Input must be NCHW 4-D, got {x}")
+    nhwc = ctx.attr("data_format", "NCHW") == "NHWC"
+    c_ax, h_ax, w_ax = (3, 1, 2) if nhwc else (1, 2, 3)
+    ctx.enforce(len(x) == 4,
+                f"Input must be {'NHWC' if nhwc else 'NCHW'} 4-D, got {x}")
     ctx.enforce(len(w) == 4, f"Filter must be [M, C/g, kh, kw], got {w}")
     groups = ctx.attr("groups", 1) or 1
-    ctx.enforce(_dim_match(x[1], w[1] * groups),
-                f"in_channels {x[1]} != filter_channels {w[1]} * groups "
+    ctx.enforce(_dim_match(x[c_ax], w[1] * groups),
+                f"in_channels {x[c_ax]} != filter_channels {w[1]} * groups "
                 f"{groups}")
     ctx.enforce(w[0] % groups == 0,
                 f"num_filters {w[0]} not divisible by groups {groups}")
     s = _pair(ctx.attr("strides", [1, 1]))
     p = _pair(ctx.attr("paddings", [0, 0]))
     d = _pair(ctx.attr("dilations", [1, 1]))
-    oh = _conv_out(x[2], w[2], p[0], s[0], d[0])
-    ow = _conv_out(x[3], w[3], p[1], s[1], d[1])
+    oh = _conv_out(x[h_ax], w[2], p[0], s[0], d[0])
+    ow = _conv_out(x[w_ax], w[3], p[1], s[1], d[1])
     ctx.enforce(oh != 0 and ow != 0 and (oh > 0 or oh == -1)
                 and (ow > 0 or ow == -1),
-                f"empty conv output {oh}x{ow} for input {x[2:]}, filter "
+                f"empty conv output {oh}x{ow} for input, filter "
                 f"{w[2:]}, stride {s}, padding {p}, dilation {d}")
-    ctx.set_output_dim("Output", (x[0], w[0], oh, ow))
+    ctx.set_output_dim(
+        "Output",
+        (x[0], oh, ow, w[0]) if nhwc else (x[0], w[0], oh, ow))
 
 
 @register_infer_shape("pool2d")
@@ -192,20 +197,26 @@ def _pool2d(ctx):
     x = ctx.input_dim("X")
     if x is None:
         return
-    ctx.enforce(len(x) == 4, f"X must be NCHW 4-D, got {x}")
+    nhwc = ctx.attr("data_format", "NCHW") == "NHWC"
+    c_ax, h_ax, w_ax = (3, 1, 2) if nhwc else (1, 2, 3)
+    ctx.enforce(len(x) == 4,
+                f"X must be {'NHWC' if nhwc else 'NCHW'} 4-D, got {x}")
     if ctx.attr("global_pooling", False):
-        ctx.set_output_dim("Out", (x[0], x[1], 1, 1))
+        ctx.set_output_dim(
+            "Out", (x[0], 1, 1, x[c_ax]) if nhwc else (x[0], x[c_ax], 1, 1))
         return
     k = _pair(ctx.attr("ksize", [1, 1]))
     s = _pair(ctx.attr("strides", [1, 1]))
     p = _pair(ctx.attr("paddings", [0, 0]))
     ceil_mode = ctx.attr("ceil_mode", False)
-    oh = _pool_out(x[2], k[0], p[0], s[0], ceil_mode)
-    ow = _pool_out(x[3], k[1], p[1], s[1], ceil_mode)
+    oh = _pool_out(x[h_ax], k[0], p[0], s[0], ceil_mode)
+    ow = _pool_out(x[w_ax], k[1], p[1], s[1], ceil_mode)
     ctx.enforce((oh > 0 or oh == -1) and (ow > 0 or ow == -1),
-                f"empty pool output {oh}x{ow} for input {x[2:]}, ksize {k}, "
+                f"empty pool output {oh}x{ow}, ksize {k}, "
                 f"stride {s}, padding {p}")
-    ctx.set_output_dim("Out", (x[0], x[1], oh, ow))
+    ctx.set_output_dim(
+        "Out",
+        (x[0], oh, ow, x[c_ax]) if nhwc else (x[0], x[c_ax], oh, ow))
 
 
 @register_infer_shape("mul")
@@ -439,7 +450,7 @@ def _batch_norm(ctx):
     if x is None:
         return
     ctx.enforce(2 <= len(x) <= 5, f"X rank must be 2..5, got {x}")
-    c = x[1]
+    c = x[-1] if ctx.attr("data_layout", "NCHW") == "NHWC" else x[1]
     for slot in ("Scale", "Bias", "Mean", "Variance"):
         s = ctx.input_dim(slot)
         if s is not None and c != -1:

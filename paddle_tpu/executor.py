@@ -94,8 +94,13 @@ def _program_has_host_ops(program):
 def stack_multi_step_feeds(program, feed, iters):
     """list-of-dicts -> one dict of [K, ...] jnp arrays for an iters=K scan
     (shared by Executor and ParallelExecutor); a dict is trusted to be
-    pre-stacked (leading axis == iters, checked). Rejects ragged (LoD)
-    feeds and casts to each program var's declared dtype."""
+    pre-stacked (leading axis == iters, checked). Sequence feeds ride too:
+    SeqTensors (e.g. from create_bucketed_seq_tensor) whose K steps share
+    one (ntokens, batch) shape stack componentwise — SeqTensor is a pytree,
+    so lax.scan slices the leading axis of data and lengths together.
+    Ragged feeds whose shapes differ across steps are rejected with a
+    pointer to the bucketing bridge. Dense feeds cast to each program
+    var's declared dtype."""
     import jax.numpy as jnp
 
     if isinstance(feed, (list, tuple)):
@@ -113,20 +118,44 @@ def stack_multi_step_feeds(program, feed, iters):
             if any(isinstance(v, SeqTensor)
                    or (isinstance(v, LoDTensor) and v.lod())
                    for v in vals):
-                raise ValueError(
-                    f"iters > 1 does not support ragged (LoD) feeds "
-                    f"({n!r}); pad to dense first")
+                seqs = [executor_core.feed_to_tracevalue(v) for v in vals]
+                if not all(isinstance(s, SeqTensor) for s in seqs):
+                    raise ValueError(
+                        f"feed {n!r} mixes ragged and dense values across "
+                        f"the {iters} steps")
+                shapes = {(s.data.shape, s.lengths.shape) for s in seqs}
+                if len(shapes) != 1:
+                    raise ValueError(
+                        f"iters > 1 needs ONE static shape per feed, but "
+                        f"ragged feed {n!r} varies across steps "
+                        f"({sorted(shapes)}); bucket-and-pad first "
+                        f"(fluid.create_bucketed_seq_tensor)")
+                stacked[n] = SeqTensor(
+                    jnp.stack([s.data for s in seqs], 0),
+                    jnp.stack([s.lengths for s in seqs], 0))
+                continue
             stacked[n] = np.stack([np.asarray(v) for v in vals], 0)
         feed = stacked
     vals = {}
     gb = program.global_block()
     for name, value in feed.items():
         var = gb.vars.get(name)
-        if isinstance(value, SeqTensor) or \
-                (isinstance(value, LoDTensor) and value.lod()):
+        if isinstance(value, SeqTensor):
+            # pre-stacked [K, ...] SeqTensor (built above or by the caller)
+            if np.shape(value.data)[0] != iters or \
+                    np.shape(value.lengths)[0] != iters:
+                raise ValueError(
+                    f"stacked SeqTensor feed {name!r} must carry a leading "
+                    f"[K={iters}] axis on data and lengths, got "
+                    f"{np.shape(value.data)} / {np.shape(value.lengths)}")
+            vals[name] = value
+            continue
+        if isinstance(value, LoDTensor) and value.lod():
             raise ValueError(
-                f"iters > 1 does not support ragged (LoD) feeds "
-                f"({name!r}); pad to dense first")
+                f"iters > 1 takes ragged feeds as per-step LIST dicts "
+                f"(bucketed to one shape, see "
+                f"fluid.create_bucketed_seq_tensor); a single pre-stacked "
+                f"LoDTensor ({name!r}) is not supported")
         tv = value if hasattr(value, "dtype") else np.asarray(value)
         if len(np.shape(tv)) == 0:
             raise ValueError(
@@ -287,8 +316,19 @@ class Executor:
             if leaves:
                 np.asarray(jax.device_get(jnp_ravel_first(leaves[0])))
             import sys
+            # reference FLAGS_benchmark also reports per-op memory
+            # (executor.cc:339); XLA owns allocation here, so the
+            # equivalent debugging signal is the device's peak-HBM mark
+            mem = ""
+            try:
+                stats = jax_device_for(self.place).memory_stats() or {}
+                peak = stats.get("peak_bytes_in_use")
+                if peak is not None:
+                    mem = f" peak_hbm={peak / 1e6:.1f}MB"
+            except Exception:
+                pass
             print(f"[paddle_tpu] run: {(time.perf_counter() - t0) * 1000:.3f}"
-                  f" ms (fetches={len(fetches)})", file=sys.stderr)
+                  f" ms (fetches={len(fetches)}){mem}", file=sys.stderr)
         if flags.get("check_nan_inf"):
             # per-op blame isn't available inside one XLA computation; check
             # the step boundary (fetches + updated state) and name the var
@@ -322,13 +362,17 @@ class Executor:
             amp.fingerprint(),
             flags.get("fuse_optimizer_ops"),
             flags.get("debug_nans"),
+            flags.get("fold_ema_multi_step"),
             ("iters", iters),
         )
         entry = self._compile_cache.get(cache_key) if use_cache else None
         if entry is None:
             step = executor_core.build_step_fn(
                 program, fetch_names, state_out_names)
-            multi = executor_core.build_multi_step_fn(step, iters)
+            ema = executor_core.collect_ema_states(
+                program, state_out_names, fetch_names) \
+                if flags.get("fold_ema_multi_step") else {}
+            multi = executor_core.build_multi_step_fn(step, iters, ema=ema)
             compiled = executor_core.compile_step_fn(
                 multi, donate_state=not flags.get("debug_nans"))
             entry = (compiled, state_names, state_out_names)

@@ -112,6 +112,16 @@ define("debug_nans", bool, False,
        "offending jitted computation op-by-op and raises at the exact "
        "primitive. Heavier than check_nan_inf's step-boundary scan; use "
        "to localize, not in production runs.")
+define("fold_ema_multi_step", bool, False,
+       "Under Executor.run(iters=K), keep batch-norm running statistics "
+       "OUT of the lax.scan carry (they are pure EMA recurrences, read by "
+       "nothing else in a training program) and reconstruct the exact "
+       "K-step fold after the scan. Built to shrink the scan's back-edge "
+       "copy set (docs/perf_r04.md residual) but measured NO gain on the "
+       "bench chip (ResNet-50 bs128 K=40: 2938 on vs 2944-2950 off — the "
+       "stacked per-step stats + post-scan fold cost what the copies "
+       "saved; docs/perf_r05.md). Default OFF, kept as an opt-in for "
+       "topologies with much larger normalization state.")
 define("fuse_optimizer_ops", bool, False,
        "Batch identical small-parameter optimizer updates (sgd/momentum) "
        "into one kernel call over concatenated flats. Default OFF: on the "

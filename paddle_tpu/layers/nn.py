@@ -405,11 +405,14 @@ def softmax(input, param_attr=None, bias_attr=None, use_cudnn=True, name=None):
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           use_mkldnn=False, act=None, name=None):
-    """reference layers/nn.py:1132."""
+           use_mkldnn=False, act=None, name=None, data_format="NCHW"):
+    """reference layers/nn.py:1132. data_format (TPU extension): "NCHW"
+    (reference default) or "NHWC" activations; filters stay OIHW in both so
+    parameters are layout-independent."""
     helper = LayerHelper("conv2d", **locals())
     dtype = helper.input_dtype()
-    num_channels = input.shape[1]
+    nhwc = data_format == "NHWC"
+    num_channels = input.shape[-1 if nhwc else 1]
     if groups is None:
         num_filter_channels = num_channels
         groups = 1
@@ -431,11 +434,13 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         std = (2.0 / (filter_size[0] ** 2 * num_channels)) ** 0.5
         return Normal(0.0, std, 0)
 
+    h_ax, w_ax = (1, 2) if nhwc else (2, 3)
     pre_bias_shape = None
-    if input.shape and None not in input.shape[2:]:
-        oh = (input.shape[2] + 2 * padding[0] - (dilation[0] * (filter_size[0] - 1) + 1)) // stride[0] + 1
-        ow = (input.shape[3] + 2 * padding[1] - (dilation[1] * (filter_size[1] - 1) + 1)) // stride[1] + 1
-        pre_bias_shape = (input.shape[0], num_filters, oh, ow)
+    if input.shape and None not in (input.shape[h_ax], input.shape[w_ax]):
+        oh = (input.shape[h_ax] + 2 * padding[0] - (dilation[0] * (filter_size[0] - 1) + 1)) // stride[0] + 1
+        ow = (input.shape[w_ax] + 2 * padding[1] - (dilation[1] * (filter_size[1] - 1) + 1)) // stride[1] + 1
+        pre_bias_shape = (input.shape[0], oh, ow, num_filters) if nhwc \
+            else (input.shape[0], num_filters, oh, ow)
 
     filter_param = helper.create_parameter(
         attr=helper.param_attr, shape=filter_shape, dtype=dtype,
@@ -452,9 +457,13 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
             "dilations": dilation,
             "groups": groups,
             "use_cudnn": use_cudnn,
+            "data_format": data_format,
         },
     )
-    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    if nhwc:
+        pre_act = helper.append_bias_op(pre_bias, dim_start=3, dim_end=4)
+    else:
+        pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
     return helper.append_activation(pre_act)
 
 
@@ -489,12 +498,14 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
            global_pooling=False, use_cudnn=True, ceil_mode=False, use_mkldnn=False,
-           name=None):
-    """reference layers/nn.py:1441."""
+           name=None, data_format="NCHW"):
+    """reference layers/nn.py:1441. data_format: NCHW (default) or NHWC."""
     if pool_type not in ["max", "avg"]:
         raise ValueError(f"Unknown pool_type {pool_type}")
     helper = LayerHelper("pool2d", **locals())
     dtype = helper.input_dtype()
+    nhwc = data_format == "NHWC"
+    h_ax, w_ax, c_ax = (1, 2, 3) if nhwc else (2, 3, 1)
 
     def _pair(v):
         return list(v) if isinstance(v, (list, tuple)) else [v, v]
@@ -503,13 +514,16 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
     pool_stride = _pair(pool_stride)
     pool_padding = _pair(pool_padding)
     shape = None
-    if input.shape and None not in input.shape[2:] and not global_pooling:
+    if input.shape and None not in (input.shape[h_ax], input.shape[w_ax]) \
+            and not global_pooling:
         rnd = math.ceil if ceil_mode else math.floor
-        oh = int(rnd((input.shape[2] + 2 * pool_padding[0] - pool_size[0]) / pool_stride[0])) + 1
-        ow = int(rnd((input.shape[3] + 2 * pool_padding[1] - pool_size[1]) / pool_stride[1])) + 1
-        shape = (input.shape[0], input.shape[1], oh, ow)
+        oh = int(rnd((input.shape[h_ax] + 2 * pool_padding[0] - pool_size[0]) / pool_stride[0])) + 1
+        ow = int(rnd((input.shape[w_ax] + 2 * pool_padding[1] - pool_size[1]) / pool_stride[1])) + 1
+        shape = (input.shape[0], oh, ow, input.shape[c_ax]) if nhwc \
+            else (input.shape[0], input.shape[c_ax], oh, ow)
     elif global_pooling and input.shape:
-        shape = (input.shape[0], input.shape[1], 1, 1)
+        shape = (input.shape[0], 1, 1, input.shape[c_ax]) if nhwc \
+            else (input.shape[0], input.shape[c_ax], 1, 1)
     pool_out = helper.create_tmp_variable(dtype, shape=shape)
     helper.append_op(
         "pool2d",
@@ -523,6 +537,7 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
             "paddings": pool_padding,
             "use_cudnn": use_cudnn,
             "ceil_mode": ceil_mode,
+            "data_format": data_format,
         },
     )
     return pool_out

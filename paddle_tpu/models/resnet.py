@@ -19,43 +19,51 @@ import numpy as np
 import paddle_tpu as fluid
 
 
-def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu"):
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
+                  layout="NCHW"):
     conv1 = fluid.layers.conv2d(
         input=input, filter_size=filter_size, num_filters=ch_out,
-        stride=stride, padding=padding, act=None, bias_attr=False)
-    return fluid.layers.batch_norm(input=conv1, act=act)
+        stride=stride, padding=padding, act=None, bias_attr=False,
+        data_format=layout)
+    return fluid.layers.batch_norm(input=conv1, act=act, data_layout=layout)
 
 
-def shortcut(input, ch_out, stride):
-    ch_in = input.shape[1]
+def shortcut(input, ch_out, stride, layout="NCHW"):
+    ch_in = input.shape[-1 if layout == "NHWC" else 1]
     if ch_in != ch_out:
-        return conv_bn_layer(input, ch_out, 1, stride, 0, None)
+        return conv_bn_layer(input, ch_out, 1, stride, 0, None,
+                             layout=layout)
     return input
 
 
-def basicblock(input, ch_out, stride):
-    short = shortcut(input, ch_out, stride)
-    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None)
+def basicblock(input, ch_out, stride, layout="NCHW"):
+    short = shortcut(input, ch_out, stride, layout)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, layout=layout)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, layout=layout)
     return fluid.layers.elementwise_add(x=short, y=conv2, act="relu")
 
 
-def bottleneck(input, ch_out, stride):
-    short = shortcut(input, ch_out * 4, stride)
-    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, stride=1, padding=1)
-    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None)
+def bottleneck(input, ch_out, stride, layout="NCHW"):
+    short = shortcut(input, ch_out * 4, stride, layout)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, layout=layout)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, stride=1, padding=1,
+                          layout=layout)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
+                          layout=layout)
     return fluid.layers.elementwise_add(x=short, y=conv3, act="relu")
 
 
-def layer_warp(block_func, input, ch_out, count, stride):
-    res_out = block_func(input, ch_out, stride)
+def layer_warp(block_func, input, ch_out, count, stride, layout="NCHW"):
+    res_out = block_func(input, ch_out, stride, layout)
     for _ in range(1, count):
-        res_out = block_func(res_out, ch_out, 1)
+        res_out = block_func(res_out, ch_out, 1, layout)
     return res_out
 
 
-def resnet_imagenet(input, class_dim, depth=50):
+def resnet_imagenet(input, class_dim, depth=50, layout="NCHW"):
+    """layout="NHWC" (TPU extension): channels-last activations end to end
+    — input must then be [N, H, W, C]; parameters are layout-independent
+    (filters stay OIHW), so checkpoints transfer between layouts."""
     cfg = {
         18: ([2, 2, 2, 1], basicblock),
         34: ([3, 4, 6, 3], basicblock),
@@ -64,16 +72,18 @@ def resnet_imagenet(input, class_dim, depth=50):
         152: ([3, 8, 36, 3], bottleneck),
     }
     stages, block_func = cfg[depth]
-    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2, padding=3)
+    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
+                          padding=3, layout=layout)
     pool1 = fluid.layers.pool2d(
-        input=conv1, pool_type="avg", pool_size=3, pool_stride=2)
-    res1 = layer_warp(block_func, pool1, 64, stages[0], 1)
-    res2 = layer_warp(block_func, res1, 128, stages[1], 2)
-    res3 = layer_warp(block_func, res2, 256, stages[2], 2)
-    res4 = layer_warp(block_func, res3, 512, stages[3], 2)
+        input=conv1, pool_type="avg", pool_size=3, pool_stride=2,
+        data_format=layout)
+    res1 = layer_warp(block_func, pool1, 64, stages[0], 1, layout)
+    res2 = layer_warp(block_func, res1, 128, stages[1], 2, layout)
+    res3 = layer_warp(block_func, res2, 256, stages[2], 2, layout)
+    res4 = layer_warp(block_func, res3, 512, stages[3], 2, layout)
     pool2 = fluid.layers.pool2d(
         input=res4, pool_size=7, pool_type="avg", pool_stride=1,
-        global_pooling=True)
+        global_pooling=True, data_format=layout)
     out = fluid.layers.fc(input=pool2, size=class_dim, act="softmax")
     return out
 

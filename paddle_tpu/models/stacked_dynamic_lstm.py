@@ -29,7 +29,11 @@ def get_model(args):
     proj = fluid.layers.fc(input=sentence, size=lstm_size * 4,
                            bias_attr=False)
     hidden, _cell = fluid.layers.dynamic_lstm(
-        input=proj, size=lstm_size * 4, use_peepholes=False)
+        input=proj, size=lstm_size * 4, use_peepholes=False,
+        # static scan bound: without it the scan trip count defaults to
+        # the batch's FLAT token total — fine for eager shapes, 10-20x
+        # wasteful under bucketed feeding (benchmark --max_seq_len)
+        max_len=getattr(args, "max_seq_len", None))
 
     last = fluid.layers.sequence_pool(hidden, "last")
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")

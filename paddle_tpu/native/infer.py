@@ -57,6 +57,27 @@ class NativePredictor:
     feeds: {name: np.ndarray}; names must cover the model's feed list."""
 
     def __init__(self, model_dir):
+        # the C++ runtime's conv/pool kernels are NCHW-only (runtime.h);
+        # refuse NHWC programs loudly instead of computing garbage when a
+        # spatial dim happens to match the filter's channel count
+        import json
+        import os
+
+        model_path = os.path.join(str(model_dir), "__model__")
+        if os.path.exists(model_path):
+            with open(model_path) as f:
+                desc = json.load(f)
+            for block in desc.get("program", {}).get("blocks", []):
+                for op in block.get("ops", []):
+                    attrs = op.get("attrs", {})
+                    if attrs.get("data_format") == "NHWC" or \
+                            attrs.get("data_layout") == "NHWC":
+                        raise RuntimeError(
+                            f"native predictor: op {op.get('type')!r} uses "
+                            f"NHWC data layout, which the C++ runtime does "
+                            f"not implement (NCHW kernels only) — export "
+                            f"the model with data_format='NCHW' "
+                            f"(parameters are layout-independent)")
         lib = _load()
         self._h = lib.pt_create(str(model_dir).encode())
         if not self._h:

@@ -9,6 +9,7 @@ accumulation in float32 via preferred_element_type for bf16 inputs).
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core.registry import register_op, register_grad_maker
@@ -128,10 +129,26 @@ def scale_op(ctx, ins, attrs):
     return out(Out=o.astype(x.dtype))
 
 
-@register_op("mean")
+@register_op("mean", lod_aware=True)
 def mean_op(ctx, ins, attrs):
-    # fluid has no 0-d tensors: mean_op.cc infers Out as {1}
-    return out(Out=jnp.mean(first(ins, "X")).reshape(1))
+    # fluid has no 0-d tensors: mean_op.cc infers Out as {1}.
+    # lod_aware for BUCKET-PADDED sequences (create_bucketed_seq_tensor):
+    # a SeqTensor may carry tail padding rows beyond sum(lengths); the mean
+    # must average REAL tokens only. For unpadded inputs the mask is
+    # all-true and this reduces to a plain mean.
+    x = first(ins, "X")
+    from ..core.registry import SeqTensor
+
+    if isinstance(x, SeqTensor):
+        mask = x.token_mask()
+        data = x.data
+        m = mask.reshape((-1,) + (1,) * (data.ndim - 1))
+        total = jnp.sum(jnp.where(m, data.astype(jnp.float32), 0.0))
+        denom = jnp.sum(mask).astype(jnp.float32) * float(
+            np.prod(data.shape[1:]) or 1)
+        return out(Out=(total / jnp.maximum(denom, 1.0))
+                   .astype(data.dtype).reshape(1))
+    return out(Out=jnp.mean(x).reshape(1))
 
 
 def _reduce(fn):

@@ -34,11 +34,16 @@ def _pref(x):
 # ---------------------------------------------------------------------------
 # Convolution family
 # ---------------------------------------------------------------------------
-def _conv_nd(x, w, strides, paddings, dilations, groups):
+def _conv_nd(x, w, strides, paddings, dilations, groups, data_format="NCHW"):
     dims = x.ndim - 2
-    dn = lax.conv_dimension_numbers(
-        x.shape, w.shape, ("NCHW", "OIHW", "NCHW") if dims == 2 else ("NCDHW", "OIDHW", "NCDHW")
-    )
+    # filters stay OIHW in EVERY layout so parameters (and checkpoints)
+    # are layout-independent; only the activation layout changes
+    if dims == 2:
+        spec = ("NHWC", "OIHW", "NHWC") if data_format == "NHWC" \
+            else ("NCHW", "OIHW", "NCHW")
+    else:
+        spec = ("NCDHW", "OIDHW", "NCDHW")
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, spec)
     o = lax.conv_general_dilated(
         x,
         w.astype(x.dtype),
@@ -63,6 +68,7 @@ def conv2d_op(ctx, ins, attrs):
             attrs.get("paddings", [0, 0]),
             attrs.get("dilations", [1, 1]),
             attrs.get("groups", 1),
+            attrs.get("data_format", "NCHW"),
         )
     )
 
@@ -71,7 +77,8 @@ def conv2d_op(ctx, ins, attrs):
 def depthwise_conv2d_op(ctx, ins, attrs):
     x, w = first(ins, "Input"), first(ins, "Filter")
     a = dict(attrs)
-    a["groups"] = x.shape[1]
+    a["groups"] = x.shape[
+        -1 if a.get("data_format", "NCHW") == "NHWC" else 1]
     return conv2d_op(ctx, ins, a)
 
 
@@ -132,25 +139,33 @@ def pool2d_op(ctx, ins, attrs):
     ksize = list(attrs.get("ksize", [2, 2]))
     strides = list(attrs.get("strides", [1, 1]))
     paddings = list(attrs.get("paddings", [0, 0]))
+    nhwc = attrs.get("data_format", "NCHW") == "NHWC"
+    h_ax, w_ax = (1, 2) if nhwc else (2, 3)
     if attrs.get("global_pooling", False):
-        ksize = [x.shape[2], x.shape[3]]
+        ksize = [x.shape[h_ax], x.shape[w_ax]]
         paddings = [0, 0]
         strides = [1, 1]
-    window = (1, 1, ksize[0], ksize[1])
-    strides_ = (1, 1, strides[0], strides[1])
-    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]), (paddings[1], paddings[1]))
+
+    def spatial(hv, wv, rest=(1, 1)):
+        return (rest[0], hv, wv, rest[1]) if nhwc \
+            else (rest[0], rest[1], hv, wv)
+
+    window = spatial(ksize[0], ksize[1])
+    strides_ = spatial(strides[0], strides[1])
+    pads = spatial((paddings[0], paddings[0]), (paddings[1], paddings[1]),
+                   rest=((0, 0), (0, 0)))
     if attrs.get("ceil_mode", False):
         # extend right/bottom padding so the window count rounds up
         def extra(size, k, s, p):
             n = math.ceil((size + 2 * p - k) / s) + 1
             return max(0, (n - 1) * s + k - size - 2 * p)
 
-        pads = (
-            (0, 0),
-            (0, 0),
-            (paddings[0], paddings[0] + extra(x.shape[2], ksize[0], strides[0], paddings[0])),
-            (paddings[1], paddings[1] + extra(x.shape[3], ksize[1], strides[1], paddings[1])),
-        )
+        pads = spatial(
+            (paddings[0], paddings[0] + extra(
+                x.shape[h_ax], ksize[0], strides[0], paddings[0])),
+            (paddings[1], paddings[1] + extra(
+                x.shape[w_ax], ksize[1], strides[1], paddings[1])),
+            rest=((0, 0), (0, 0)))
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         o = lax.reduce_window(x, np.asarray(init, x.dtype), lax.max, window, strides_, pads)

@@ -30,6 +30,11 @@ class DeviceChunkFeeder:
     place:         paddle_tpu Place the chunks are staged to (default: the
                    default jax device)
     capacity:      staged chunks buffered ahead (2 = classic double buffer)
+    stage_fn:      optional override for the host->device staging step,
+                   called as stage_fn(chunk_index, {name: stacked_ndarray})
+                   -> {name: device_array}. Default: jax.device_put per
+                   array. Benchmarks use this to measure the pipeline
+                   machinery with transfers taken off the critical path.
 
     The tail is dropped if fewer than `chunk` batches remain (a partial
     chunk would force a second XLA compile for the odd shape).
@@ -37,11 +42,12 @@ class DeviceChunkFeeder:
 
     _END = object()
 
-    def __init__(self, reader, chunk, place=None, capacity=2):
+    def __init__(self, reader, chunk, place=None, capacity=2, stage_fn=None):
         self._reader = reader
         self._chunk = int(chunk)
         self._place = place
         self._cap = int(capacity)
+        self._stage_fn = stage_fn
         if self._chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
 
@@ -74,6 +80,7 @@ class DeviceChunkFeeder:
         def work():
             try:
                 batches = []
+                chunk_idx = 0
                 for batch in self._reader():
                     if stop.is_set():
                         return
@@ -84,8 +91,12 @@ class DeviceChunkFeeder:
                         n: np.stack([np.asarray(b[n]) for b in batches], 0)
                         for n in batches[0]
                     }
-                    staged = {n: jax.device_put(a, dev)
-                              for n, a in stacked.items()}
+                    if self._stage_fn is not None:
+                        staged = self._stage_fn(chunk_idx, stacked)
+                    else:
+                        staged = {n: jax.device_put(a, dev)
+                                  for n, a in stacked.items()}
+                    chunk_idx += 1
                     if not put(staged):
                         return
                     batches = []

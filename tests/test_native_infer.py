@@ -138,3 +138,77 @@ def test_errors_are_surfaced(tmp_path):
     with pytest.raises(ValueError, match="missing feeds"):
         pred.run({})
     pred.close()
+
+
+@pytest.mark.slow
+def test_vgg16_round_trip(tmp_path):
+    """r4 VERDICT task 7: a full vgg16 save_inference_model output must
+    serve through libptinfer.so with numeric parity vs the XLA executor
+    (reference inference/io.cc serves arbitrary saved ProgramDescs)."""
+    from paddle_tpu.models.vgg import vgg16_bn_drop
+
+    rng = np.random.RandomState(11)
+
+    def build():
+        img = fluid.layers.data(name="data", shape=[3, 32, 32],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        net = vgg16_bn_drop(img)
+        probs = fluid.layers.fc(input=net, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=probs, label=label))
+        return [img], [probs], loss
+
+    def feed():
+        return {"data": rng.randn(2, 3, 32, 32).astype(np.float32),
+                "label": rng.randint(0, 10, (2, 1)).astype(np.int64)}
+
+    fd, want = _train_and_save(tmp_path, build, feed, steps=2)
+    pred = NativePredictor(str(tmp_path))
+    got = pred.run({"data": fd["data"]})
+    np.testing.assert_allclose(got[0], want[0], rtol=2e-3, atol=1e-4)
+    np.testing.assert_allclose(got[0].sum(axis=1), np.ones(2), rtol=1e-4)
+    pred.close()
+
+
+@pytest.mark.slow
+def test_se_resnext_round_trip(tmp_path):
+    """se_resnext50: grouped convolutions (cardinality 32) + SE gating
+    (axis-broadcast elementwise_mul) through the native predictor."""
+    from paddle_tpu.models.se_resnext import se_resnext
+
+    rng = np.random.RandomState(13)
+
+    def build():
+        img = fluid.layers.data(name="data", shape=[3, 32, 32],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        probs = se_resnext(img, 10, depth=50)
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=probs, label=label))
+        return [img], [probs], loss
+
+    def feed():
+        return {"data": rng.randn(2, 3, 32, 32).astype(np.float32),
+                "label": rng.randint(0, 10, (2, 1)).astype(np.int64)}
+
+    fd, want = _train_and_save(tmp_path, build, feed, steps=2)
+    pred = NativePredictor(str(tmp_path))
+    got = pred.run({"data": fd["data"]})
+    np.testing.assert_allclose(got[0], want[0], rtol=2e-3, atol=1e-4)
+    pred.close()
+
+
+def test_nhwc_program_refused_with_clear_error(tmp_path):
+    """The C++ runtime is NCHW-only: an NHWC save must be refused at load
+    with a message naming the fix, never served as silent garbage."""
+    with program_guard(Program(), Program()):
+        img = fluid.layers.data(name="img", shape=[8, 8, 2],
+                                dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=3, filter_size=3,
+                                padding=1, data_format="NHWC")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        fluid.io.save_inference_model(str(tmp_path), ["img"], [c], exe)
+    with pytest.raises(RuntimeError, match="NHWC"):
+        NativePredictor(str(tmp_path))

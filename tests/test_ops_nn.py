@@ -531,3 +531,73 @@ class TestBatchNormLargeMeanStability:
         assert 0.5 < got_y.std() < 2.0, got_y.std()
         got_m = np.asarray(mv).reshape(-1)
         np.testing.assert_allclose(got_m, x.mean(axis=(0, 2, 3)), rtol=1e-5)
+
+
+def test_conv_pool_bn_nhwc_matches_nchw():
+    """data_format="NHWC" (TPU extension; reference kernels expose layout
+    via OpKernelType + DataTransform, operator.h:377, data_transform.cc:29):
+    channels-last must produce bit-comparable results to NCHW with the SAME
+    parameters — filters stay OIHW in both layouts."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models.resnet import conv_bn_layer, layer_warp, basicblock
+
+    def build(layout):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            shape = [8, 8, 3] if layout == "NHWC" else [3, 8, 8]
+            x = fluid.layers.data(name="x", shape=shape, dtype="float32")
+            c1 = conv_bn_layer(x, 8, 3, 1, 1, layout=layout)
+            p1 = fluid.layers.pool2d(c1, pool_size=2, pool_stride=2,
+                                     pool_type="max", data_format=layout)
+            r1 = layer_warp(basicblock, p1, 8, 1, 1, layout)
+            p2 = fluid.layers.pool2d(r1, pool_size=2, pool_type="avg",
+                                     global_pooling=True, data_format=layout)
+            logits = fluid.layers.fc(input=p2, size=5)
+        return main, startup, logits
+
+    xv = np.random.RandomState(0).randn(4, 3, 8, 8).astype("float32")
+    outs = {}
+    for layout in ("NCHW", "NHWC"):
+        main, startup, logits = build(layout)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            feed = xv if layout == "NCHW" else np.ascontiguousarray(
+                xv.transpose(0, 2, 3, 1))
+            o, = exe.run(main, feed={"x": feed}, fetch_list=[logits])
+            outs[layout] = np.asarray(o)
+    np.testing.assert_allclose(outs["NCHW"], outs["NHWC"],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_conv2d_nhwc_trains():
+    """Gradients flow through NHWC convs (vjp of the layout-parameterized
+    kernel); loss decreases on a fixed mapping."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6, 6, 2], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        c = fluid.layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                                act="relu", data_format="NHWC")
+        p = fluid.layers.pool2d(c, global_pooling=True, pool_type="avg",
+                                data_format="NHWC")
+        pred = fluid.layers.fc(input=p, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    rs = np.random.RandomState(2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(30):
+            xv = rs.randn(8, 6, 6, 2).astype("float32")
+            yv = xv.mean(axis=(1, 2, 3), keepdims=False)[:, None] * 3
+            lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
