@@ -159,7 +159,13 @@ def train(args):
     is_seq = args.model in ("stacked_dynamic_lstm", "machine_translation")
     unit = "words/s" if is_seq else "images/s"
 
-    want = args.iterations + args.skip_batch_num
+    K = max(1, args.iters_per_call)
+    # chunked dispatch warms TWO calls before timing (call 1 compiles,
+    # call 2 re-specializes to the donated-output layouts — the bench
+    # methodology), so the skip covers at least 2 chunks
+    skip_steps = max(args.skip_batch_num, 2 * K) if K > 1 \
+        else args.skip_batch_num
+    want = args.iterations + skip_steps
     batches = []
     for batch in train_reader():
         if len(batches) >= want:
@@ -181,6 +187,17 @@ def train(args):
     if args.bucket_tokens > 0 and is_seq:
         totals = bucket_totals(batches, args.model, args.bucket_tokens)
         print(f"bucketed flat totals: {totals}", file=sys.stderr)
+    if args.max_seq_len is not None and is_seq:
+        # the bound becomes dynamic_lstm's scan trip count; a longer
+        # sequence would be SILENTLY truncated and the words/s inflated
+        longest = max(max(len(s[i]) for s in b)
+                      for b in batches
+                      for i in _SEQ_FEEDS[args.model].values())
+        if longest > args.max_seq_len:
+            raise ValueError(
+                f"--max_seq_len {args.max_seq_len} < longest sequence in "
+                f"the run ({longest} tokens): the kernel would silently "
+                f"truncate — raise the bound")
 
     def make_feed(batch):
         if totals is not None:
@@ -191,13 +208,6 @@ def train(args):
     elapsed = 0.0
     loss = None
     it = 0
-    K = max(1, args.iters_per_call)
-    # chunked dispatch warms TWO calls before timing (call 1 compiles,
-    # call 2 re-specializes to the donated-output layouts — the bench
-    # methodology), so the skip covers at least 2 chunks regardless of
-    # the per-step default
-    skip_steps = max(args.skip_batch_num, 2 * K) if K > 1 \
-        else args.skip_batch_num
     try:
         for _pass in range(args.pass_num):
             if K > 1:

@@ -406,6 +406,98 @@ def collect_ema_states(program, state_out_names, fetch_names=()):
     return ema
 
 
+class PackPlan:
+    """Packed small-state storage for the multi-step scan (r5 perf
+    experiment; docs/perf_r05.md residual: ~11 ms/step of launch-bound
+    per-parameter update kernels on ResNet-50).
+
+    Instead of carrying each small float parameter/accumulator as its own
+    scan-carry leaf (one XLA buffer + back-edge copy + update kernel
+    each), all small same-dtype mut-state entries live CONCATENATED in one
+    buffer. Inside the step they are sliced back to views (slices fuse
+    into the consumers), and the updated values concatenate into the new
+    packed buffer — which is the donated carry leaf, so the update lowers
+    to (ideally) one fused kernel over one aliased buffer. Contrast with
+    r4's rejected concat-fusion, whose slice-back wrote SEPARATE per-param
+    output buffers and broke donation aliasing.
+    """
+
+    MAX_NUMEL = 1 << 16
+
+    def __init__(self, mut_values, exclude=()):
+        by_dtype = {}
+        for n in sorted(mut_values):
+            v = mut_values[n]
+            if n in exclude or isinstance(v, SeqTensor) \
+                    or not hasattr(v, "dtype") or not hasattr(v, "shape"):
+                continue
+            if not jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating):
+                continue
+            size = int(np.prod(v.shape)) if v.shape else 1
+            if size > self.MAX_NUMEL:
+                continue
+            by_dtype.setdefault(str(v.dtype), []).append(
+                (n, size, tuple(v.shape)))
+        self.groups = []
+        for dtype, entries in sorted(by_dtype.items()):
+            if len(entries) < 2:
+                continue
+            offs, off = [], 0
+            for _, size, _ in entries:
+                offs.append(off)
+                off += size
+            self.groups.append(dict(
+                key=f"__packed__{dtype}", dtype=dtype, total=off,
+                entries=[(n, o, s, shp) for (n, s, shp), o
+                         in zip(entries, offs)]))
+        self.packed_names = {n for g in self.groups
+                             for (n, _, _, _) in g["entries"]}
+
+    @staticmethod
+    def pack_group(g, values):
+        """One group's members ({name: value}) -> the packed 1-D buffer.
+        The single definition of the packed layout's write side."""
+        return jnp.concatenate([
+            jnp.asarray(values[n]).reshape(-1)
+            for n, _, _, _ in g["entries"]])
+
+    @staticmethod
+    def group_views(g, P):
+        """Packed buffer -> member views, in g["entries"] order. The
+        single definition of the packed layout's read side (also what the
+        Executor jits for the post-call scope write-back)."""
+        return [jax.lax.dynamic_slice(P, (off,), (size,)).reshape(shape)
+                for _, off, size, shape in g["entries"]]
+
+    def unpack_into(self, packed_mut):
+        """packed mut dict -> {name: view} for every packed member."""
+        views = {}
+        for g in self.groups:
+            for (n, _, _, _), v in zip(
+                    g["entries"], self.group_views(g, packed_mut[g["key"]])):
+                views[n] = v
+        return views
+
+    def wrap_step(self, step):
+        """step over individual names -> step over packed mut state."""
+
+        def wrapped(mut_state, const_state, feeds, rng):
+            mut = {n: v for n, v in mut_state.items()
+                   if not n.startswith("__packed__")}
+            views = self.unpack_into(mut_state)
+            mut.update(views)
+            fetches, new_mut = step(mut, const_state, feeds, rng)
+            out = {n: v for n, v in new_mut.items()
+                   if n not in self.packed_names}
+            for g in self.groups:
+                merged = {n: new_mut.get(n, views[n])
+                          for n, _, _, _ in g["entries"]}
+                out[g["key"]] = self.pack_group(g, merged)
+            return fetches, out
+
+        return wrapped
+
+
 def build_multi_step_fn(step, iters, ema=None):
     """Wrap a step function in a lax.scan over `iters` pre-stacked feeds.
 

@@ -363,23 +363,9 @@ class Executor:
             flags.get("fuse_optimizer_ops"),
             flags.get("debug_nans"),
             flags.get("fold_ema_multi_step"),
+            flags.get("pack_small_state"),
             ("iters", iters),
         )
-        entry = self._compile_cache.get(cache_key) if use_cache else None
-        if entry is None:
-            step = executor_core.build_step_fn(
-                program, fetch_names, state_out_names)
-            ema = executor_core.collect_ema_states(
-                program, state_out_names, fetch_names) \
-                if flags.get("fold_ema_multi_step") else {}
-            multi = executor_core.build_multi_step_fn(step, iters, ema=ema)
-            compiled = executor_core.compile_step_fn(
-                multi, donate_state=not flags.get("debug_nans"))
-            entry = (compiled, state_names, state_out_names)
-            if use_cache:
-                self._compile_cache[cache_key] = entry
-        compiled, state_names, state_out_names = entry
-
         out_set = set(state_out_names)
         mut_state, const_state = {}, {}
         for n in state_names:
@@ -387,6 +373,58 @@ class Executor:
             if isinstance(v, LoDTensor):
                 v = executor_core.feed_to_tracevalue(v)
             (mut_state if n in out_set else const_state)[n] = v
+
+        entry = self._compile_cache.get(cache_key) if use_cache else None
+        if entry is None:
+            step = executor_core.build_step_fn(
+                program, fetch_names, state_out_names)
+            ema = executor_core.collect_ema_states(
+                program, state_out_names, fetch_names) \
+                if flags.get("fold_ema_multi_step") else {}
+            plan = None
+            if flags.get("pack_small_state"):
+                plan = executor_core.PackPlan(mut_state, exclude=set(ema))
+                if plan.groups:
+                    step = plan.wrap_step(step)
+                else:
+                    plan = None
+            multi = executor_core.build_multi_step_fn(step, iters, ema=ema)
+            compiled = executor_core.compile_step_fn(
+                multi, donate_state=not flags.get("debug_nans"))
+            unpackers = {}
+            if plan is not None:
+                for g in plan.groups:
+                    unpackers[g["key"]] = jax.jit(
+                        lambda P, _g=g:
+                        executor_core.PackPlan.group_views(_g, P))
+            entry = (compiled, state_names, state_out_names, plan,
+                     unpackers, {})
+            if use_cache:
+                self._compile_cache[cache_key] = entry
+        compiled, state_names, state_out_names, plan, unpackers, memo = entry
+
+        if plan is not None:
+            # reuse the previous call's packed buffers when the scope still
+            # holds exactly the views we wrote back (the steady state) —
+            # repacking costs one eager concat per group otherwise
+            packed_in = {}
+            for g in plan.groups:
+                prev = memo.get(g["key"])
+                if prev is not None and all(
+                        scope.find_var(n) is v
+                        for (n, _, _, _), v in zip(g["entries"], prev[1])):
+                    packed_in[g["key"]] = prev[0]
+            repack = {n: v for n, v in mut_state.items()
+                      if n in plan.packed_names}
+            mut_state = {n: v for n, v in mut_state.items()
+                         if n not in plan.packed_names}
+            for g in plan.groups:
+                if g["key"] in packed_in:
+                    mut_state[g["key"]] = packed_in[g["key"]]
+                else:
+                    mut_state[g["key"]] = \
+                        executor_core.PackPlan.pack_group(g, repack)
+
         key = id(program)
         step0 = self._step_counter.get(key, 0)
         self._step_counter[key] = step0 + iters
@@ -395,6 +433,16 @@ class Executor:
         rng = (jax.random.PRNGKey(program.random_seed),
                jnp.asarray(step0, jnp.int32))
         fetches, new_mut = compiled(mut_state, const_state, feed_vals, rng)
+        if plan is not None:
+            plain = {n: v for n, v in new_mut.items()
+                     if not n.startswith("__packed__")}
+            for g in plan.groups:
+                P = new_mut[g["key"]]
+                views = unpackers[g["key"]](P)
+                for (n, _, _, _), v in zip(g["entries"], views):
+                    plain[n] = v
+                memo[g["key"]] = (P, views)
+            new_mut = plain
         for n, v in new_mut.items():
             scope.set_var(n, v)
         if flags.get("check_nan_inf"):

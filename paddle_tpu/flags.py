@@ -122,6 +122,18 @@ define("fold_ema_multi_step", bool, False,
        "stacked per-step stats + post-scan fold cost what the copies "
        "saved; docs/perf_r05.md). Default OFF, kept as an opt-in for "
        "topologies with much larger normalization state.")
+define("pack_small_state", bool, False,
+       "Under Executor.run(iters=K), carry all small (<=64Ki elems) float "
+       "mut-state entries as ONE concatenated buffer per dtype instead of "
+       "one scan-carry leaf each (core/executor_core.py PackPlan): slices "
+       "fuse into consumers, and the per-parameter optimizer updates "
+       "concatenate into the donated packed carry — the "
+       "aliasing-preserving answer to the suspected launch-bound update "
+       "kernels. Measured NO gain (2951 vs 2959 img/s, ResNet-50 NHWC "
+       "K=40): traces show the eliminated 85 kernels/step reappear inside "
+       "the conv fusions — the step is scheduler-bound, not launch-bound "
+       "(docs/perf_r05.md). Default OFF; the mechanism stays for "
+       "topologies with far more small state.")
 define("fuse_optimizer_ops", bool, False,
        "Batch identical small-parameter optimizer updates (sgd/momentum) "
        "into one kernel call over concatenated flats. Default OFF: on the "

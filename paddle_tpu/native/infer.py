@@ -21,6 +21,32 @@ _CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
 _lib = None
 
 
+def reject_nhwc_program(model_dir, what):
+    """The C++ runtime's conv/pool kernels are NCHW-only (runtime.h):
+    refuse NHWC programs loudly instead of computing garbage when a
+    spatial dim happens to match the filter's channel count. Shared by
+    NativePredictor and NativeTrainer."""
+    import json
+    import os
+
+    model_path = os.path.join(str(model_dir), "__model__")
+    if not os.path.exists(model_path):
+        return
+    with open(model_path) as f:
+        desc = json.load(f)
+    for block in desc.get("program", {}).get("blocks", []):
+        for op in block.get("ops", []):
+            attrs = op.get("attrs", {})
+            if attrs.get("data_format") == "NHWC" or \
+                    attrs.get("data_layout") == "NHWC":
+                raise RuntimeError(
+                    f"native {what}: op {op.get('type')!r} uses NHWC data "
+                    f"layout, which the C++ runtime does not implement "
+                    f"(NCHW kernels only) — export the model with "
+                    f"data_format='NCHW' (parameters are "
+                    f"layout-independent)")
+
+
 def _load():
     global _lib
     if _lib is None:
@@ -57,27 +83,7 @@ class NativePredictor:
     feeds: {name: np.ndarray}; names must cover the model's feed list."""
 
     def __init__(self, model_dir):
-        # the C++ runtime's conv/pool kernels are NCHW-only (runtime.h);
-        # refuse NHWC programs loudly instead of computing garbage when a
-        # spatial dim happens to match the filter's channel count
-        import json
-        import os
-
-        model_path = os.path.join(str(model_dir), "__model__")
-        if os.path.exists(model_path):
-            with open(model_path) as f:
-                desc = json.load(f)
-            for block in desc.get("program", {}).get("blocks", []):
-                for op in block.get("ops", []):
-                    attrs = op.get("attrs", {})
-                    if attrs.get("data_format") == "NHWC" or \
-                            attrs.get("data_layout") == "NHWC":
-                        raise RuntimeError(
-                            f"native predictor: op {op.get('type')!r} uses "
-                            f"NHWC data layout, which the C++ runtime does "
-                            f"not implement (NCHW kernels only) — export "
-                            f"the model with data_format='NCHW' "
-                            f"(parameters are layout-independent)")
+        reject_nhwc_program(model_dir, "predictor")
         lib = _load()
         self._h = lib.pt_create(str(model_dir).encode())
         if not self._h:

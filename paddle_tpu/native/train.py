@@ -51,6 +51,9 @@ class NativeTrainer:
     """Train a save_train_model directory with the C++ runtime."""
 
     def __init__(self, model_dir):
+        from .infer import reject_nhwc_program
+
+        reject_nhwc_program(model_dir, "trainer")
         lib = _load()
         self._h = lib.ptt_create(str(model_dir).encode())
         if not self._h:

@@ -341,3 +341,54 @@ def test_bucketed_seq_tensor_parity_and_iters():
         out, = e.run(main3, feed=feed_list, fetch_list=[loss3], iters=3)
         k_losses = [float(v) for v in np.asarray(out).reshape(-1)]
     np.testing.assert_allclose(exact, k_losses, rtol=2e-5)
+
+
+def test_pack_small_state_parity():
+    """FLAGS_pack_small_state carries small float state as one packed
+    buffer per dtype inside the iters=K scan (executor_core.PackPlan):
+    losses AND every scope var must match the unpacked path across two
+    calls (the second exercises the packed-buffer memo reuse)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import flags
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[3, 6, 6],
+                                  dtype="float32")
+            c = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                    padding=1, bias_attr=False)
+            b = fluid.layers.batch_norm(c, act="relu", momentum=0.8)
+            c2 = fluid.layers.conv2d(b, num_filters=4, filter_size=3,
+                                     padding=1)
+            loss = fluid.layers.mean(c2)
+            fluid.optimizer.Momentum(
+                learning_rate=0.01, momentum=0.9).minimize(loss)
+        return main, startup, loss
+
+    feeds = [{"x": np.random.RandomState(i).randn(4, 3, 6, 6)
+              .astype("float32")} for i in range(6)]
+
+    def run(pack):
+        main, startup, loss = build()
+        s = fluid.Scope()
+        with fluid.scope_guard(s), flags.flag_guard(pack_small_state=pack):
+            e = fluid.Executor(fluid.CPUPlace())
+            e.run(startup)
+            out1, = e.run(main, feed=feeds[:3], fetch_list=[loss], iters=3)
+            out2, = e.run(main, feed=feeds[3:], fetch_list=[loss], iters=3)
+            vals = list(np.asarray(out1).reshape(-1)) + \
+                list(np.asarray(out2).reshape(-1))
+            state = {n: np.asarray(s.find_var(n))
+                     for n in s.local_var_names()
+                     if hasattr(s.find_var(n), "shape")}
+        return vals, state
+
+    v0, st0 = run(False)
+    v1, st1 = run(True)
+    np.testing.assert_allclose(v0, v1, rtol=2e-5)
+    assert set(st0) == set(st1)
+    for n in st0:
+        np.testing.assert_allclose(st0[n], st1[n], rtol=1e-4, atol=1e-6,
+                                   err_msg=n)
