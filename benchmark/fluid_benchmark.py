@@ -187,9 +187,11 @@ def train(args):
     if args.bucket_tokens > 0 and is_seq:
         totals = bucket_totals(batches, args.model, args.bucket_tokens)
         print(f"bucketed flat totals: {totals}", file=sys.stderr)
-    if args.max_seq_len is not None and is_seq:
-        # the bound becomes dynamic_lstm's scan trip count; a longer
-        # sequence would be SILENTLY truncated and the words/s inflated
+    # only stacked_dynamic_lstm consumes the bound (its dynamic_lstm scan
+    # trip count); a longer sequence would be SILENTLY truncated and the
+    # words/s inflated, so refuse up front
+    if args.max_seq_len is not None and \
+            args.model == "stacked_dynamic_lstm":
         longest = max(max(len(s[i]) for s in b)
                       for b in batches
                       for i in _SEQ_FEEDS[args.model].values())

@@ -29,22 +29,30 @@ def reject_nhwc_program(model_dir, what):
     import json
     import os
 
-    model_path = os.path.join(str(model_dir), "__model__")
-    if not os.path.exists(model_path):
-        return
-    with open(model_path) as f:
-        desc = json.load(f)
-    for block in desc.get("program", {}).get("blocks", []):
-        for op in block.get("ops", []):
-            attrs = op.get("attrs", {})
-            if attrs.get("data_format") == "NHWC" or \
-                    attrs.get("data_layout") == "NHWC":
-                raise RuntimeError(
-                    f"native {what}: op {op.get('type')!r} uses NHWC data "
-                    f"layout, which the C++ runtime does not implement "
-                    f"(NCHW kernels only) — export the model with "
-                    f"data_format='NCHW' (parameters are "
-                    f"layout-independent)")
+    # predictor dirs carry __model__ {"program": ...}; trainer dirs carry
+    # __train__ {"main_program": ..., "startup_program": ...} (io.py
+    # save_inference_model / save_train_model)
+    programs = []
+    for fname, keys in (("__model__", ("program",)),
+                        ("__train__", ("main_program", "startup_program"))):
+        path = os.path.join(str(model_dir), fname)
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            desc = json.load(f)
+        programs.extend(desc.get(k) for k in keys if desc.get(k))
+    for prog in programs:
+        for block in prog.get("blocks", []):
+            for op in block.get("ops", []):
+                attrs = op.get("attrs", {})
+                if attrs.get("data_format") == "NHWC" or \
+                        attrs.get("data_layout") == "NHWC":
+                    raise RuntimeError(
+                        f"native {what}: op {op.get('type')!r} uses NHWC "
+                        f"data layout, which the C++ runtime does not "
+                        f"implement (NCHW kernels only) — export the "
+                        f"model with data_format='NCHW' (parameters are "
+                        f"layout-independent)")
 
 
 def _load():

@@ -86,3 +86,24 @@ def test_native_train_matches_python_executor(tmp_path):
         ]
     np.testing.assert_allclose(native_losses, py_losses, rtol=2e-4,
                                atol=1e-5)
+
+
+def test_trainer_refuses_nhwc_program(tmp_path):
+    """Same NCHW-only guard as the predictor, on the __train__ schema:
+    an NHWC training program must be refused at load, not trained as
+    silent garbage through the NCHW C++ kernels."""
+    from paddle_tpu.core.framework import Program, program_guard
+    from paddle_tpu.native.train import NativeTrainer
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[8, 8, 2],
+                                dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=3, filter_size=3,
+                                padding=1, data_format="NHWC")
+        loss = fluid.layers.mean(c)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        fluid.io.save_train_model(str(tmp_path), ["img"], loss, main,
+                                  startup)
+    with pytest.raises(RuntimeError, match="NHWC"):
+        NativeTrainer(str(tmp_path))

@@ -298,10 +298,10 @@ def test_bucketed_seq_tensor_parity_and_iters():
         with fluid.unique_name.guard(), fluid.program_guard(main, startup):
             data = fluid.layers.data(name="words", shape=[1], lod_level=1,
                                      dtype="int64")
-            emb = fluid.layers.embedding(input=data, size=[50, 16])
-            proj = fluid.layers.fc(input=emb, size=64, bias_attr=False)
+            emb = fluid.layers.embedding(input=data, size=[50, 8])
+            proj = fluid.layers.fc(input=emb, size=32, bias_attr=False)
             hidden, _ = fluid.layers.dynamic_lstm(
-                input=proj, size=64, use_peepholes=False)
+                input=proj, size=32, use_peepholes=False, max_len=16)
             last = fluid.layers.sequence_pool(hidden, "last")
             label = fluid.layers.data(name="label", shape=[1], dtype="int64")
             logit = fluid.layers.fc(input=last, size=2, act="softmax")
