@@ -187,11 +187,16 @@ def train(args):
     if args.bucket_tokens > 0 and is_seq:
         totals = bucket_totals(batches, args.model, args.bucket_tokens)
         print(f"bucketed flat totals: {totals}", file=sys.stderr)
-    # only stacked_dynamic_lstm consumes the bound (its dynamic_lstm scan
-    # trip count); a longer sequence would be SILENTLY truncated and the
-    # words/s inflated, so refuse up front
-    if args.max_seq_len is not None and \
-            args.model == "stacked_dynamic_lstm":
+    if args.max_seq_len is not None:
+        # only stacked_dynamic_lstm consumes the bound (its dynamic_lstm
+        # scan trip count); refuse it elsewhere rather than let the user
+        # believe an ignored flag bounded anything
+        if args.model != "stacked_dynamic_lstm":
+            raise ValueError(
+                f"--max_seq_len only applies to stacked_dynamic_lstm "
+                f"(the {args.model} model sets its own scan bounds)")
+        # a sequence longer than the bound would be SILENTLY truncated
+        # and the words/s inflated, so refuse up front
         longest = max(max(len(s[i]) for s in b)
                       for b in batches
                       for i in _SEQ_FEEDS[args.model].values())

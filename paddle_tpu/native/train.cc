@@ -11,9 +11,10 @@
 //
 // The forward kernels come from the shared runtime (runtime.h run_op); this
 // file adds what training needs on top: initializer kernels, the gradient
-// kernels the IR-level backward emits for the demo-net family
-// (mean/square_error_cost/elementwise_add/mul/relu), and the sgd update,
-// applied in place on the persistent scope.
+// kernels the IR-level backward emits for the mlp AND cnn families
+// (mean/square_error_cost/elementwise_add/mul/relu plus conv2d/pool2d/
+// training-mode batch_norm with their backwards), and the sgd/momentum
+// updates applied in place on the persistent scope.
 //
 // Build: paddle_tpu/native/build.py train_lib() -> libpttrain.so
 // ABI (0 on success, -1 on error; ptt_last_error()):
@@ -28,6 +29,7 @@
 
 #include "runtime.h"
 
+#include <limits>
 #include <random>
 
 namespace {
@@ -75,6 +77,31 @@ Tensor reduce_to_like(const Tensor& dout, const Tensor& y, int axis) {
       for (int64_t c = 0; c < post; ++c)
         o.f()[b] += d.f()[(a * mid + b) * post + c];
   return o;
+}
+
+// per-channel batch statistics over [N, C, inner] (biased variance) —
+// the ONE definition shared by training-mode batch_norm and its grad
+void compute_batch_stats(const Tensor& x, int64_t N, int64_t C,
+                         int64_t inner, std::vector<float>& m,
+                         std::vector<float>& v) {
+  int64_t cnt = N * inner;
+  m.assign(C, 0.f);
+  v.assign(C, 0.f);
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t c = 0; c < C; ++c) {
+      const float* xi = x.f() + (n * C + c) * inner;
+      for (int64_t i = 0; i < inner; ++i) m[c] += xi[i];
+    }
+  for (int64_t c = 0; c < C; ++c) m[c] /= (float)cnt;
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t c = 0; c < C; ++c) {
+      const float* xi = x.f() + (n * C + c) * inner;
+      for (int64_t i = 0; i < inner; ++i) {
+        float d = xi[i] - m[c];
+        v[c] += d * d;
+      }
+    }
+  for (int64_t c = 0; c < C; ++c) v[c] /= (float)cnt;
 }
 
 // returns true when handled; false -> fall through to the inference run_op
@@ -210,6 +237,274 @@ bool run_train_op(Trainer& tr, const OpDesc& op, Env& env) {
       env.local[op.out("Y@GRAD")] = std::move(o);
     }
     return true;
+  }
+
+  // ---- CNN training kernels (r5: extends the native trainer beyond the
+  // mlp family; reference demo_trainer.cc executes any ProgramDesc) ----
+
+  if (t == "batch_norm" && !op.attr_bool("is_test", false)) {
+    // TRAINING semantics: normalize by batch statistics and fold them
+    // into the running stats in the persistent scope (the shared
+    // runtime.h kernel is inference-only: running stats, no update)
+    Tensor x_s, sc_s, bi_s;
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
+    const Tensor& sc = as_f32(need(env, op.in("Scale")), sc_s);
+    const Tensor& bi = as_f32(need(env, op.in("Bias")), bi_s);
+    float eps = (float)op.attr_num("epsilon", 1e-5);
+    float mom = (float)op.attr_num("momentum", 0.9);
+    int64_t N = x.dims[0], C = x.dims.size() > 1 ? x.dims[1] : 1;
+    int64_t inner = 1;
+    for (size_t i = 2; i < x.dims.size(); ++i) inner *= x.dims[i];
+    std::vector<float> m, v;
+    compute_batch_stats(x, N, C, inner, m, v);
+    Tensor o = make_f32(x.dims);
+    for (int64_t n = 0; n < N; ++n)
+      for (int64_t c = 0; c < C; ++c) {
+        float inv = 1.f / std::sqrt(v[c] + eps);
+        float a = sc.f()[c] * inv;
+        float b = bi.f()[c] - m[c] * a;
+        const float* xi = x.f() + (n * C + c) * inner;
+        float* oi = o.f() + (n * C + c) * inner;
+        for (int64_t i = 0; i < inner; ++i) oi[i] = xi[i] * a + b;
+      }
+    env.local[op.out("Y")] = std::move(o);
+    // running-stat EMA update, in place on the persistent scope
+    // (MeanOut/VarianceOut alias Mean/Variance like the reference)
+    auto upd = [&](const std::string& name, const std::vector<float>& s) {
+      auto it = tr.scope.find(name);
+      if (it == tr.scope.end()) return;
+      Tensor& r = it->second;
+      if (r.dtype != F32) r = to_f32(r);
+      for (int64_t c = 0; c < C && c < r.numel(); ++c)
+        r.f()[c] = r.f()[c] * mom + s[c] * (1.f - mom);
+    };
+    upd(op.in("Mean"), m);
+    upd(op.in("Variance"), v);
+    if (!op.out("SavedMean").empty()) {
+      Tensor sm = make_f32({C});
+      std::copy(m.begin(), m.end(), sm.f());
+      env.local[op.out("SavedMean")] = std::move(sm);
+    }
+    if (!op.out("SavedVariance").empty()) {
+      Tensor sv = make_f32({C});
+      for (int64_t c = 0; c < C; ++c)
+        sv.f()[c] = 1.f / std::sqrt(v[c] + eps);
+      env.local[op.out("SavedVariance")] = std::move(sv);
+    }
+    return true;
+  }
+
+  if (t == "batch_norm_grad") {
+    // d(batch-normalized y)/d{x, scale, bias} using BATCH statistics
+    // recomputed from X (the default vjp maker forwards X/Scale/Bias)
+    Tensor x_s, sc_s, d_s;
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
+    const Tensor& sc = as_f32(need(env, op.in("Scale")), sc_s);
+    const Tensor& dy = as_f32(need(env, op.in("Y@GRAD")), d_s);
+    float eps = (float)op.attr_num("epsilon", 1e-5);
+    int64_t N = x.dims[0], C = x.dims.size() > 1 ? x.dims[1] : 1;
+    int64_t inner = 1;
+    for (size_t i = 2; i < x.dims.size(); ++i) inner *= x.dims[i];
+    int64_t cnt = N * inner;
+    std::vector<float> m, v, dys(C, 0.f), dyx(C, 0.f);
+    compute_batch_stats(x, N, C, inner, m, v);
+    // per-channel sums of dy and dy*xhat
+    for (int64_t n = 0; n < N; ++n)
+      for (int64_t c = 0; c < C; ++c) {
+        float inv = 1.f / std::sqrt(v[c] + eps);
+        const float* xi = x.f() + (n * C + c) * inner;
+        const float* di = dy.f() + (n * C + c) * inner;
+        for (int64_t i = 0; i < inner; ++i) {
+          dys[c] += di[i];
+          dyx[c] += di[i] * (xi[i] - m[c]) * inv;
+        }
+      }
+    if (!op.out("Scale@GRAD").empty()) {
+      Tensor g = make_f32({C});
+      std::copy(dyx.begin(), dyx.end(), g.f());
+      env.local[op.out("Scale@GRAD")] = std::move(g);
+    }
+    if (!op.out("Bias@GRAD").empty()) {
+      Tensor g = make_f32({C});
+      std::copy(dys.begin(), dys.end(), g.f());
+      env.local[op.out("Bias@GRAD")] = std::move(g);
+    }
+    if (!op.out("X@GRAD").empty()) {
+      Tensor g = make_f32(x.dims);
+      for (int64_t n = 0; n < N; ++n)
+        for (int64_t c = 0; c < C; ++c) {
+          float inv = 1.f / std::sqrt(v[c] + eps);
+          float a = sc.f()[c] * inv;
+          const float* xi = x.f() + (n * C + c) * inner;
+          const float* di = dy.f() + (n * C + c) * inner;
+          float* gi = g.f() + (n * C + c) * inner;
+          for (int64_t i = 0; i < inner; ++i) {
+            float xhat = (xi[i] - m[c]) * inv;
+            gi[i] = a * (di[i] - dys[c] / (float)cnt -
+                         xhat * dyx[c] / (float)cnt);
+          }
+        }
+      env.local[op.out("X@GRAD")] = std::move(g);
+    }
+    return true;
+  }
+
+  if (t == "conv2d_grad" || t == "depthwise_conv2d_grad") {
+    Tensor x_s, w_s, d_s;
+    const Tensor& x = as_f32(need(env, op.in("Input")), x_s);
+    const Tensor& w = as_f32(need(env, op.in("Filter")), w_s);
+    const Tensor& dout = as_f32(need(env, op.in("Output@GRAD")), d_s);
+    auto strides = op.attr_ints("strides");
+    auto pads = op.attr_ints("paddings");
+    auto dil = op.attr_ints("dilations");
+    if (strides.empty()) strides = {1, 1};
+    if (pads.empty()) pads = {0, 0};
+    if (dil.empty()) dil = {1, 1};
+    int64_t groups = (int64_t)op.attr_num("groups", 1);
+    if (t == "depthwise_conv2d_grad") groups = x.dims[1];
+    int64_t N = x.dims[0], C = x.dims[1], H = x.dims[2], W = x.dims[3];
+    int64_t O = w.dims[0], KC = w.dims[1], KH = w.dims[2], KW = w.dims[3];
+    int64_t OH = dout.dims[2], OW = dout.dims[3];
+    int64_t cpg = C / groups, opg = O / groups;
+    (void)KC;
+    bool want_dx = !op.out("Input@GRAD").empty();
+    bool want_dw = !op.out("Filter@GRAD").empty();
+    Tensor dx, dw;
+    if (want_dx) {
+      dx = make_f32(x.dims);
+      std::fill(dx.f(), dx.f() + dx.numel(), 0.f);
+    }
+    if (want_dw) {
+      dw = make_f32(w.dims);
+      std::fill(dw.f(), dw.f() + dw.numel(), 0.f);
+    }
+    for (int64_t n = 0; n < N; ++n)
+      for (int64_t oc = 0; oc < O; ++oc) {
+        int64_t g = oc / opg;
+        for (int64_t oh = 0; oh < OH; ++oh)
+          for (int64_t ow = 0; ow < OW; ++ow) {
+            float go = dout.f()[((n * O + oc) * OH + oh) * OW + ow];
+            if (go == 0.f) continue;
+            for (int64_t ic = 0; ic < cpg; ++ic)
+              for (int64_t kh = 0; kh < KH; ++kh) {
+                int64_t ih = oh * strides[0] - pads[0] + kh * dil[0];
+                if (ih < 0 || ih >= H) continue;
+                for (int64_t kw = 0; kw < KW; ++kw) {
+                  int64_t iw = ow * strides[1] - pads[1] + kw * dil[1];
+                  if (iw < 0 || iw >= W) continue;
+                  int64_t xo = ((n * C + g * cpg + ic) * H + ih) * W + iw;
+                  int64_t wo = ((oc * cpg + ic) * KH + kh) * KW + kw;
+                  if (want_dx) dx.f()[xo] += go * w.f()[wo];
+                  if (want_dw) dw.f()[wo] += go * x.f()[xo];
+                }
+              }
+          }
+      }
+    if (want_dx) env.local[op.out("Input@GRAD")] = std::move(dx);
+    if (want_dw) env.local[op.out("Filter@GRAD")] = std::move(dw);
+    return true;
+  }
+
+  if (t == "pool2d_grad") {
+    Tensor x_s, d_s;
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
+    const Tensor& dout = as_f32(need(env, op.in("Out@GRAD")), d_s);
+    std::string ptype = "max";
+    if (op.attrs->at("pooling_type")->kind == JValue::STR)
+      ptype = op.attrs->at("pooling_type")->s;
+    auto ksize = op.attr_ints("ksize");
+    auto strides = op.attr_ints("strides");
+    auto pads = op.attr_ints("paddings");
+    if (ksize.empty()) ksize = {2, 2};
+    if (strides.empty()) strides = {1, 1};
+    if (pads.empty()) pads = {0, 0};
+    int64_t N = x.dims[0], C = x.dims[1], H = x.dims[2], W = x.dims[3];
+    if (op.attr_bool("global_pooling", false)) {
+      ksize = {H, W};
+      strides = {1, 1};
+      pads = {0, 0};
+    }
+    int64_t OH = dout.dims[2], OW = dout.dims[3];
+    bool exclusive = op.attr_bool("exclusive", true);
+    Tensor dx = make_f32(x.dims);
+    std::fill(dx.f(), dx.f() + dx.numel(), 0.f);
+    for (int64_t n = 0; n < N; ++n)
+      for (int64_t c = 0; c < C; ++c)
+        for (int64_t oh = 0; oh < OH; ++oh)
+          for (int64_t ow = 0; ow < OW; ++ow) {
+            float go = dout.f()[((n * C + c) * OH + oh) * OW + ow];
+            int64_t h0 = oh * strides[0] - pads[0];
+            int64_t w0 = ow * strides[1] - pads[1];
+            if (ptype == "max") {
+              // route to the window's argmax (recomputed from X, same
+              // first-wins tie-break as a forward scan)
+              int64_t bh = -1, bw = -1;
+              float best = -std::numeric_limits<float>::infinity();
+              for (int64_t kh = 0; kh < ksize[0]; ++kh) {
+                int64_t ih = h0 + kh;
+                if (ih < 0 || ih >= H) continue;
+                for (int64_t kw = 0; kw < ksize[1]; ++kw) {
+                  int64_t iw = w0 + kw;
+                  if (iw < 0 || iw >= W) continue;
+                  float xv = x.f()[((n * C + c) * H + ih) * W + iw];
+                  if (xv > best) {
+                    best = xv;
+                    bh = ih;
+                    bw = iw;
+                  }
+                }
+              }
+              if (bh >= 0)
+                dx.f()[((n * C + c) * H + bh) * W + bw] += go;
+            } else {  // avg
+              int64_t cnt = 0;
+              for (int64_t kh = 0; kh < ksize[0]; ++kh) {
+                int64_t ih = h0 + kh;
+                if (ih >= 0 && ih < H)
+                  for (int64_t kw = 0; kw < ksize[1]; ++kw) {
+                    int64_t iw = w0 + kw;
+                    if (iw >= 0 && iw < W) ++cnt;
+                  }
+              }
+              int64_t denom = exclusive ? cnt : ksize[0] * ksize[1];
+              if (denom == 0) continue;
+              float share = go / (float)denom;
+              for (int64_t kh = 0; kh < ksize[0]; ++kh) {
+                int64_t ih = h0 + kh;
+                if (ih < 0 || ih >= H) continue;
+                for (int64_t kw = 0; kw < ksize[1]; ++kw) {
+                  int64_t iw = w0 + kw;
+                  if (iw < 0 || iw >= W) continue;
+                  dx.f()[((n * C + c) * H + ih) * W + iw] += share;
+                }
+              }
+            }
+          }
+    env.local[op.out("X@GRAD")] = std::move(dx);
+    return true;
+  }
+
+  if (t == "momentum") {
+    auto pit = tr.scope.find(op.in("Param"));
+    auto vit = tr.scope.find(op.in("Velocity"));
+    if (pit == tr.scope.end() || vit == tr.scope.end())
+      throw std::runtime_error("momentum: param/velocity not in scope: " +
+                               op.in("Param"));
+    Tensor& p = pit->second;
+    Tensor& vel = vit->second;
+    Tensor g_s, lr_s;
+    const Tensor& g = as_f32(need(env, op.in("Grad")), g_s);
+    const Tensor& lr = as_f32(need(env, op.in("LearningRate")), lr_s);
+    float mu = (float)op.attr_num("mu", 0.9);
+    bool nesterov = op.attr_bool("use_nesterov", false);
+    if (p.dtype != F32) p = to_f32(p);
+    if (vel.dtype != F32) vel = to_f32(vel);
+    for (int64_t i = 0; i < p.numel(); ++i) {
+      float nv = mu * vel.f()[i] + g.f()[i];
+      vel.f()[i] = nv;
+      p.f()[i] -= lr.f()[0] * (nesterov ? g.f()[i] + mu * nv : nv);
+    }
+    return true;  // ParamOut/VelocityOut alias inputs: updated in place
   }
 
   if (t == "sgd") {

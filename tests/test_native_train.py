@@ -107,3 +107,77 @@ def test_trainer_refuses_nhwc_program(tmp_path):
                                   startup)
     with pytest.raises(RuntimeError, match="NHWC"):
         NativeTrainer(str(tmp_path))
+
+
+def _build_and_save_cnn(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 8, 8],
+                                dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, act="relu")
+        b = fluid.layers.batch_norm(c)
+        p = fluid.layers.pool2d(b, pool_size=2, pool_stride=2,
+                                pool_type="max")
+        pred = fluid.layers.fc(input=p, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.Momentum(
+            learning_rate=0.01, momentum=0.9).minimize(loss)
+    d = str(tmp_path / "cnn_train_model")
+    fluid.io.save_train_model(d, ["img", "y"], loss, main, startup)
+    return d, main, startup, loss
+
+
+def test_native_cnn_train_converges(tmp_path):
+    """r5: the native trainer covers the CNN family — conv2d_grad /
+    pool2d_grad / training-mode batch_norm(+grad) / momentum run in C++
+    (reference demo_trainer.cc executes any ProgramDesc)."""
+    d, *_ = _build_and_save_cnn(tmp_path)
+    tr = NativeTrainer(d)
+    rs = np.random.RandomState(0)
+    xv = rs.randn(8, 1, 8, 8).astype("float32")
+    yv = (xv.mean(axis=(1, 2, 3))[:, None] * 2.0).astype("float32")
+    losses = [tr.step({"img": xv, "y": yv}) for _ in range(25)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+def test_native_cnn_matches_python_executor(tmp_path):
+    """Step-for-step parity on the CNN path: same init, same batches =>
+    same losses as the Python/XLA executor (fp32). Pins conv/pool/bn
+    backward math and the batch-stat EMA update."""
+    d, main, startup, loss = _build_and_save_cnn(tmp_path)
+
+    rs = np.random.RandomState(3)
+    batches = []
+    for _ in range(6):
+        xv = rs.randn(4, 1, 8, 8).astype("float32")
+        yv = (xv.mean(axis=(1, 2, 3))[:, None] * 2.0).astype("float32")
+        batches.append({"img": xv, "y": yv})
+
+    tr = NativeTrainer(d)
+    params = ["conv2d_0.w_0", "conv2d_0.w_1", "batch_norm_0.w_0",
+              "batch_norm_0.w_1", "batch_norm_0.w_2", "batch_norm_0.w_3",
+              "fc_0.w_0", "fc_0.w_1"]
+    init = {n: np.ascontiguousarray(tr.get_var(n)) for n in params}
+    native_losses = [tr.step(b) for b in batches]
+    native_mean = np.ascontiguousarray(tr.get_var("batch_norm_0.w_2"))
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for n, v in init.items():
+            scope.set_var(n, v)
+        py_losses = [
+            float(np.asarray(exe.run(main, feed=b,
+                                     fetch_list=[loss])[0]).item())
+            for b in batches
+        ]
+        py_mean = np.asarray(scope.find_var("batch_norm_0.w_2"))
+    np.testing.assert_allclose(native_losses, py_losses, rtol=2e-3,
+                               atol=2e-4)
+    # running statistics fold identically (training-mode EMA update)
+    np.testing.assert_allclose(native_mean, py_mean, rtol=1e-3, atol=1e-5)
