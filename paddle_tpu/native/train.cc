@@ -239,6 +239,75 @@ bool run_train_op(Trainer& tr, const OpDesc& op, Env& env) {
     return true;
   }
 
+  // ---- classifier head (hard labels; reference cross_entropy_op.cc) ----
+
+  if (t == "cross_entropy") {
+    if (op.attr_bool("soft_label", false))
+      throw std::runtime_error("native cross_entropy: soft_label "
+                               "unsupported (serve via the XLA path)");
+    Tensor x_s;
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
+    const Tensor& lab = need(env, op.in("Label"));
+    int64_t N = x.dims[0], C = x.dims[1];
+    Tensor o = make_f32({N, 1});
+    for (int64_t n = 0; n < N; ++n) {
+      int64_t c = lab.as_i64(n);
+      if (c < 0 || c >= C)
+        throw std::runtime_error("cross_entropy: label out of range");
+      float p = x.f()[n * C + c];
+      o.f()[n] = -std::log(std::max(p, 1e-20f));
+    }
+    env.local[op.out("Y")] = std::move(o);
+    return true;
+  }
+  if (t == "cross_entropy_grad") {
+    Tensor x_s, d_s;
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
+    const Tensor& lab = need(env, op.in("Label"));
+    const Tensor& dy = as_f32(need(env, op.in("Y@GRAD")), d_s);
+    int64_t N = x.dims[0], C = x.dims[1];
+    Tensor g = make_f32(x.dims);
+    std::fill(g.f(), g.f() + g.numel(), 0.f);
+    for (int64_t n = 0; n < N; ++n) {
+      int64_t c = lab.as_i64(n);
+      float p = std::max(x.f()[n * C + c], 1e-20f);
+      g.f()[n * C + c] = -dy.f()[n] / p;
+    }
+    env.local[op.out("X@GRAD")] = std::move(g);
+    return true;
+  }
+  if (t == "softmax_grad") {
+    // recompute y = softmax(x) like the vjp replay, then
+    // dX = y * (dy - sum(dy * y, last axis))
+    Tensor x_s, d_s;
+    const Tensor& x = as_f32(need(env, op.in("X")), x_s);
+    const Tensor& dy = as_f32(need(env, op.in("Out@GRAD")), d_s);
+    int64_t C = x.dims.back();
+    int64_t rows = x.numel() / C;
+    Tensor g = make_f32(x.dims);
+    std::vector<float> y(C);
+    for (int64_t r = 0; r < rows; ++r) {
+      const float* xi = x.f() + r * C;
+      const float* di = dy.f() + r * C;
+      float mx = xi[0];
+      for (int64_t c = 1; c < C; ++c) mx = std::max(mx, xi[c]);
+      float z = 0.f;
+      for (int64_t c = 0; c < C; ++c) {
+        y[c] = std::exp(xi[c] - mx);
+        z += y[c];
+      }
+      float dot = 0.f;
+      for (int64_t c = 0; c < C; ++c) {
+        y[c] /= z;
+        dot += di[c] * y[c];
+      }
+      float* gi = g.f() + r * C;
+      for (int64_t c = 0; c < C; ++c) gi[c] = y[c] * (di[c] - dot);
+    }
+    env.local[op.out("X@GRAD")] = std::move(g);
+    return true;
+  }
+
   // ---- CNN training kernels (r5: extends the native trainer beyond the
   // mlp family; reference demo_trainer.cc executes any ProgramDesc) ----
 

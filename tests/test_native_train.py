@@ -181,3 +181,44 @@ def test_native_cnn_matches_python_executor(tmp_path):
                                atol=2e-4)
     # running statistics fold identically (training-mode EMA update)
     np.testing.assert_allclose(native_mean, py_mean, rtol=1e-3, atol=1e-5)
+
+
+def test_native_classifier_matches_python_executor(tmp_path):
+    """softmax + cross_entropy (hard labels) backward in C++: the native
+    classifier step must track the Python/XLA executor."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=12, act="relu")
+        probs = fluid.layers.fc(input=h, size=4, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=probs, label=label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        d = str(tmp_path / "cls")
+        fluid.io.save_train_model(d, ["x", "label"], loss, main, startup)
+
+    rs = np.random.RandomState(5)
+    batches = [{"x": rs.randn(8, 8).astype("float32"),
+                "label": rs.randint(0, 4, (8, 1)).astype("int64")}
+               for _ in range(8)]
+
+    tr = NativeTrainer(d)
+    params = ["fc_0.w_0", "fc_0.w_1", "fc_1.w_0", "fc_1.w_1"]
+    init = {n: np.ascontiguousarray(tr.get_var(n)) for n in params}
+    native_losses = [tr.step(b) for b in batches]
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for n, v in init.items():
+            scope.set_var(n, v)
+        py_losses = [
+            float(np.asarray(exe.run(main, feed=b,
+                                     fetch_list=[loss])[0]).item())
+            for b in batches
+        ]
+    np.testing.assert_allclose(native_losses, py_losses, rtol=2e-3,
+                               atol=2e-4)
+    assert native_losses[-1] < native_losses[0], native_losses
