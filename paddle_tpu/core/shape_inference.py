@@ -1243,6 +1243,14 @@ def _while_grad(ctx):
             ctx.set_output_dim("X@GRAD", d, i)
 
 
+@register_infer_shape("conditional_block_grad")
+def _conditional_block_grad(ctx):
+    for i in range(len(ctx.op.inputs.get("Input") or [])):
+        d = ctx.input_dim("Input", i)
+        if d is not None:
+            ctx.set_output_dim("Input@GRAD", d, i)
+
+
 @register_infer_shape("lod_array_length", "max_sequence_len")
 def _len_scalar(ctx):
     ctx.set_output_dim("Out", (1,))

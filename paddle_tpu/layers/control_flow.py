@@ -178,6 +178,70 @@ class BlockGuard:
         return exc_type is None
 
 
+def _sub_block_interface(parent_block, sub_block, snap_suffix):
+    """Shared by While and ConditionalBlock: derive the sub-block's
+    parent-visible reads and writes, undo constant-initializer
+    stop_gradient flags on rewritten float vars (a var the block REWRITES
+    is no longer the constant its initializer created — without this the
+    backward reach dies at every accumulator; explicit user flags on
+    computed vars stay respected), and create one pre-op snapshot var per
+    written name (the lax-idiomatic stand-in for the reference's saved
+    scopes, while_op.cc:35 / conditional_block_op.cc grad).
+
+    Returns (in_names, out_names, init_snapshot_names, input_snap_names).
+    init_snapshot_names align with out_names (pre-op values of written
+    state); input_snap_names align with in_names (values of every read AT
+    op entry — grad replay must not see values a LATER forward op wrote
+    over). Under the trace both snapshot kinds are pure aliases: zero
+    runtime cost."""
+    from .. import unique_name
+
+    x_names, inner = set(), set()
+    for op in sub_block.ops:
+        x_names.update(op.input_arg_names())
+        inner.update(op.output_arg_names())
+    # ALL written names are outputs: the flat trace env makes sub-created
+    # vars observable downstream (that is how IfElse branch outputs reach
+    # the merge), so the cotangent must be able to route back through the
+    # op. Sub-created ones get a parent-block var desc.
+    out_names = sorted(n for n in inner if n)
+    in_names = sorted(n for n in x_names if parent_block.has_var_recursive(n))
+    const_init_types = {
+        "fill_constant", "fill_constant_batch_size_like",
+        "fill_zeros_like", "uniform_random", "gaussian_random",
+    }
+    producer = {}
+    for p_op in parent_block.ops:
+        for n in p_op.output_arg_names():
+            producer[n] = p_op.type
+
+    def _var_of(n):
+        if parent_block.has_var_recursive(n):
+            return parent_block.var_recursive(n)
+        sub_v = sub_block.vars.get(n)
+        return parent_block.create_var(
+            name=n,
+            shape=sub_v.shape if sub_v is not None else None,
+            dtype=sub_v.dtype if sub_v is not None else "float32")
+
+    init_names = []
+    for n in out_names:
+        v = _var_of(n)
+        if v.dtype and "float" in str(v.dtype) \
+                and producer.get(n) in const_init_types:
+            v.stop_gradient = False
+        snap = unique_name.generate(n + snap_suffix)
+        parent_block.create_var(name=snap, shape=v.shape, dtype=v.dtype)
+        init_names.append(snap)
+    input_snap_names = []
+    for n in in_names:
+        v = parent_block.var_recursive(n)
+        snap = unique_name.generate(n + snap_suffix + "_IN")
+        parent_block.create_var(name=snap, shape=v.shape, dtype=v.dtype)
+        input_snap_names.append(snap)
+    return in_names, out_names, init_names, input_snap_names
+
+
 class While:
     """reference control_flow.py:608 — lowers to lax.while_loop.
 
@@ -204,61 +268,23 @@ class While:
         return WhileGuard(self)
 
     def complete(self, sub_block):
-        from .. import unique_name
-
         main_program = self.helper.main_program
         parent_block = main_program.block(sub_block.parent_idx)
-        x_names = set()
-        for op in sub_block.ops:
-            x_names.update(op.input_arg_names())
-        inner = set()
-        for op in sub_block.ops:
-            inner.update(op.output_arg_names())
-
         # Out: vars the loop body writes that live in the parent scope —
         # the loop's carried state (reference while_op lists these too).
         # X keeps ALL parent-visible reads, including read-AND-written
         # carried vars: their INITIAL values are loop inputs, which is what
         # makes gradients through the loop expressible at the IR level.
-        out_names = sorted(
-            n for n in inner if parent_block.has_var_recursive(n))
-        in_names = sorted(
-            n for n in x_names if parent_block.has_var_recursive(n))
-        # A float var the loop REWRITES is no longer the constant its
-        # initializer created: constant-initializer layers set
-        # stop_gradient=True on their out, which would kill the backward
-        # reach at every loop accumulator. Only initializer-produced flags
-        # are undone — an explicit user stop_gradient on a computed var is
-        # respected (its parent-block producer is not an initializer).
-        const_init_types = {
-            "fill_constant", "fill_constant_batch_size_like",
-            "fill_zeros_like", "uniform_random", "gaussian_random",
-        }
-        producer = {}
-        for p_op in parent_block.ops:
-            for n in p_op.output_arg_names():
-                producer[n] = p_op.type
-        for n in out_names:
-            v = parent_block.var_recursive(n)
-            if v.dtype and "float" in str(v.dtype) \
-                    and producer.get(n) in const_init_types:
-                v.stop_gradient = False
-        # one snapshot var per Out: while_op saves the pre-loop value there
-        # so while_grad can replay the trajectory (the reference keeps
-        # step scopes instead, while_op.cc:35 StepScopes)
-        init_names = []
-        for n in out_names:
-            snap = unique_name.generate(n + "@WHILE_INIT")
-            v = parent_block.var_recursive(n)
-            parent_block.create_var(name=snap, shape=v.shape, dtype=v.dtype)
-            init_names.append(snap)
+        in_names, out_names, init_names, in_snaps = _sub_block_interface(
+            parent_block, sub_block, "@WHILE_INIT")
         attrs = {"sub_block": sub_block}
         if self.max_trip_count is not None:
             attrs["max_trip_count"] = int(self.max_trip_count)
         parent_block.append_op(
             "while",
             {"X": in_names, "Condition": [self.cond_var]},
-            {"Out": out_names, "InitStates": init_names, "StepScopes": []},
+            {"Out": out_names, "InitStates": init_names,
+             "InputSnapshots": in_snaps, "StepScopes": []},
             attrs,
         )
 
@@ -299,10 +325,23 @@ class ConditionalBlock:
     def complete(self, sub_block):
         main_program = self.helper.main_program
         parent_block = main_program.block(sub_block.parent_idx)
+        # Input: parent-visible reads AND writes (grad path — written
+        # names must be op inputs so the backward walk applies its
+        # in-place pre/post grad semantics to them); Out + InitStates: the
+        # written state and its pre-op snapshot, so conditional_block_grad
+        # can differentiate BOTH branches (taken: vjp through the block;
+        # not taken: identity to the init). Inputs are fetched lazily:
+        # a state var first materialized INSIDE the block has no value yet.
+        in_names, out_names, init_names, in_snaps = _sub_block_interface(
+            parent_block, sub_block, "@COND_INIT")
+        extra = sorted(set(out_names) - set(in_names))
+        in_names = in_names + extra  # snapshot lists stay aligned
+        in_snaps = in_snaps + [""] * len(extra)
         parent_block.append_op(
             "conditional_block",
-            {"X": self.inputs},
-            {"Out": [], "Scope": []},
+            {"X": self.inputs, "Input": in_names},
+            {"Out": out_names, "InitStates": init_names,
+             "InputSnapshots": in_snaps, "Scope": []},
             {"sub_block": sub_block, "is_scalar_condition": self.is_scalar_condition},
         )
 

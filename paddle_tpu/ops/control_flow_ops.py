@@ -58,7 +58,11 @@ for _name in ("less_than", "less_equal", "greater_than", "greater_equal",
               "equal", "not_equal", "logical_and", "logical_or",
               "logical_xor", "logical_not"):
     set_stop_gradient_outputs(_name, ["Out"])
-set_stop_gradient_outputs("while", ["InitStates", "StepScopes"])
+set_stop_gradient_outputs(
+    "while", ["InitStates", "InputSnapshots", "StepScopes"])
+set_stop_gradient_outputs(
+    "conditional_block", ["InitStates", "InputSnapshots", "Scope"])
+from ..core import registry as _registry_mod  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -107,15 +111,55 @@ def while_op(ctx, ins, attrs):
     # (while_op.cc:35 kStepScopes, consumed by WhileGradOp :95)
     inits = dict(zip(carried, carry_init))
     env.update(dict(zip(carried, final)))
-    init_out_names = op.output("InitStates") or []
-    if init_out_names:
-        return {"InitStates": [inits.get(n) for n in out_names]}
-    return {}
+    ret = {}
+    if op.output("InitStates"):
+        ret["InitStates"] = [inits.get(n) for n in out_names]
+    if op.output("InputSnapshots"):
+        # entry-time values of every read: the grad replay must not see
+        # values a LATER forward op wrote over (pure aliases in the trace)
+        ret["InputSnapshots"] = [inits.get(n, env.get(n))
+                                 for n in op.input("X")]
+    return ret
 
 
 def _is_float(v):
     return hasattr(v, "dtype") and jnp.issubdtype(
         jnp.asarray(v).dtype, jnp.floating)
+
+
+def _refuse_ragged(opname, named_values):
+    for n, v in named_values:
+        if isinstance(v, SeqTensor):
+            raise NotImplementedError(
+                f"{opname}: ragged (LoD) state {n!r} is not supported; "
+                f"pad to dense first")
+
+
+def _cotangents(finals, gouts):
+    """Zero-filled / dtype-aligned cotangent dict for jax.vjp."""
+    cot = {}
+    for n in finals:
+        g = gouts.get(n)
+        if g is None:
+            cot[n] = jnp.zeros(finals[n].shape, finals[n].dtype)
+        else:
+            g = g.data if isinstance(g, SeqTensor) else g
+            cot[n] = jnp.asarray(g, finals[n].dtype).reshape(finals[n].shape)
+    return cot
+
+
+def _assemble_grads(names, primary, secondary, skip=()):
+    """Positional grad list for an output slot: primary dict wins, then
+    secondary; names in `skip` (synthesized zero-inits) yield None."""
+    grads = []
+    for n in names:
+        if n in primary and n not in skip:
+            grads.append(primary[n])
+        elif n in secondary:
+            grads.append(secondary[n])
+        else:
+            grads.append(None)
+    return grads
 
 
 @register_grad_maker("while")
@@ -146,6 +190,7 @@ def while_grad_maker(op, gout, gin):
             "X": op.input("X"),
             "Condition": op.input("Condition"),
             "InitStates": op.output("InitStates"),
+            "InputSnapshots": op.output("InputSnapshots") or [],
             "Out@GRAD": [g or "" for g in gout.get("Out", [])],
         },
         outputs={"X@GRAD": gin.get("X", [])},
@@ -172,15 +217,16 @@ def while_grad_op(ctx, ins, attrs):
 
     x_names = list(op.input("X"))
     x_vals = dict(zip(x_names, ins.get("X", [])))
+    snaps = ins.get("InputSnapshots") or []
+    for n, sv in zip(x_names, snaps):
+        if sv is not None:
+            # entry-time value: immune to later forward overwrites
+            x_vals[n] = sv
     inits = {n: v for n, v in zip(out_names, ins.get("InitStates", []))
              if v is not None}
     gouts = dict(zip(out_names, ins.get("Out@GRAD", [])))
 
-    for n, v in list(inits.items()) + list(x_vals.items()):
-        if isinstance(v, SeqTensor):
-            raise NotImplementedError(
-                f"while_grad: ragged (LoD) loop state {n!r} is not "
-                f"supported; pad to dense before the loop")
+    _refuse_ragged("while_grad", list(inits.items()) + list(x_vals.items()))
 
     # closure = read-only parent vars; carried = Out names (replayed state)
     closure = {n: v for n, v in x_vals.items()
@@ -214,25 +260,8 @@ def while_grad_op(ctx, ins, attrs):
         return {n: final[n] for n in diff_carry}
 
     finals, vjp_fn = jax.vjp(fwd, diff_init, diff_closure)
-    cot = {}
-    for n in finals:
-        g = gouts.get(n)
-        if g is None:
-            cot[n] = jnp.zeros(finals[n].shape, finals[n].dtype)
-        else:
-            g = g.data if isinstance(g, SeqTensor) else g
-            cot[n] = jnp.asarray(g, finals[n].dtype).reshape(finals[n].shape)
-    g_init, g_closure = vjp_fn(cot)
-
-    grads = []
-    for n in x_names:
-        if n in g_init:
-            grads.append(g_init[n])
-        elif n in g_closure:
-            grads.append(g_closure[n])
-        else:
-            grads.append(None)
-    return {"X@GRAD": grads}
+    g_init, g_closure = vjp_fn(_cotangents(finals, gouts))
+    return {"X@GRAD": _assemble_grads(x_names, g_init, g_closure)}
 
 
 @register_op("conditional_block", lod_aware=True)
@@ -276,9 +305,128 @@ def conditional_block_op(ctx, ins, attrs):
                 res.append(jnp.zeros(s.shape, s.dtype))
         return tuple(res)
 
+    # entry-time values captured BEFORE the block writes (pure aliases)
+    out_names = list(op.output("Out") or [])
+    inits = {n: env.get(n) for n in out_names}
+    entry = {n: env.get(n) for n in op.input("Input")}
     result = lax.cond(pred, true_fn, false_fn, 0)
     env.update(dict(zip(written, result)))
-    return {}
+    ret = {}
+    if op.output("InitStates"):
+        ret["InitStates"] = [inits.get(n) for n in out_names]
+    if op.output("InputSnapshots"):
+        ret["InputSnapshots"] = [entry.get(n) for n in op.input("Input")]
+    return ret
+
+
+@register_grad_maker("conditional_block")
+def conditional_block_grad_maker(op, gout, gin):
+    """reference conditional_block_op.cc ConditionalBlockGradOp: the taken
+    branch differentiates through the sub-block; the untaken branch is the
+    identity to the pre-op value. Needs the InitStates snapshots the r5
+    While machinery introduced — old descs without them refuse loudly
+    instead of returning silent [None] grads."""
+    if not op.output("InitStates"):
+        raise RuntimeError(
+            "gradient through op 'conditional_block' needs its InitStates "
+            "snapshot outputs; this program was built by an old "
+            "ConditionalBlock layer — rebuild it")
+    return [dict(
+        type="conditional_block_grad",
+        inputs={
+            "X": op.input("X"),
+            "Input": op.input("Input"),
+            "InitStates": op.output("InitStates"),
+            "InputSnapshots": op.output("InputSnapshots") or [],
+            "Out@GRAD": [g or "" for g in gout.get("Out", [])],
+        },
+        outputs={"Input@GRAD": gin.get("Input", [])},
+        attrs={
+            "sub_block": op.attrs["sub_block"],
+            "is_scalar_condition": op.attrs.get("is_scalar_condition",
+                                                False),
+            "out_names": list(op.output("Out") or []),
+        },
+    )]
+
+
+@register_op("conditional_block_grad", lod_aware=True)
+def conditional_block_grad_op(ctx, ins, attrs):
+    """vjp through lax.cond: replay the block under the SAME predicate;
+    the untaken branch passes the init values through, so their cotangent
+    is dOut exactly when the branch did not run."""
+    op = ctx.current_op
+    block = attrs["sub_block"]
+    out_names = list(attrs["out_names"])
+
+    conds = [v for v in ins.get("X", []) if v is not None]
+    cond = conds[0]
+    pred = cond.reshape(()) if attrs.get("is_scalar_condition", False) \
+        else jnp.all(cond)
+
+    in_names = list(op.input("Input"))
+    in_vals = dict(zip(in_names, ins.get("Input", [])))
+    snaps = ins.get("InputSnapshots") or []
+    for n, sv in zip(in_names, snaps):
+        if sv is not None:
+            # entry-time value: immune to later forward overwrites
+            in_vals[n] = sv
+    inits = {n: v for n, v in zip(out_names, ins.get("InitStates", []))
+             if v is not None}
+    gouts = dict(zip(out_names, ins.get("Out@GRAD", [])))
+
+    _refuse_ragged("conditional_block_grad",
+                   list(inits.items()) + list(in_vals.items()))
+
+    # every float output with an incoming cotangent must flow through the
+    # vjp, whether or not it had a pre-op value — a var first materialized
+    # INSIDE the block has no init; the forward's false branch produced
+    # zeros for it, so the replay mirrors that with a synthesized zero
+    # (its "pre-value grad" is discarded below: there is no pre-producer)
+    tracked, synthesized = {}, set()
+    for n in out_names:
+        g = gouts.get(n)
+        if n in inits and _is_float(inits[n]):
+            tracked[n] = inits[n]
+        elif g is not None and _is_float(g):
+            gd = g.data if isinstance(g, SeqTensor) else g
+            tracked[n] = jnp.zeros(jnp.shape(gd), jnp.asarray(gd).dtype)
+            synthesized.add(n)
+    const_init = {n: v for n, v in inits.items() if n not in tracked}
+
+    # reads that are ALSO outputs take their value from the snapshot (the
+    # env holds post-op values by grad time)
+    reads = {}
+    for n, v in in_vals.items():
+        if n in tracked or n in const_init:
+            continue
+        if v is not None:
+            reads[n] = v
+    diff_reads = {n: v for n, v in reads.items() if _is_float(v)}
+    const_reads = {n: v for n, v in reads.items() if n not in diff_reads}
+
+    def fwd(d_init, d_reads):
+        def true_fn(operands):
+            di, dr = operands
+            local = dict(const_reads)
+            local.update(const_init)
+            local.update(dr)
+            local.update(di)
+            for n in ctx.env:
+                local.setdefault(n, ctx.env[n])
+            ctx.run_block(block, local)
+            return {n: local[n] for n in d_init}
+
+        def false_fn(operands):
+            di, _ = operands
+            return dict(di)
+
+        return lax.cond(pred, true_fn, false_fn, (d_init, d_reads))
+
+    finals, vjp_fn = jax.vjp(fwd, tracked, diff_reads)
+    g_init, g_reads = vjp_fn(_cotangents(finals, gouts))
+    return {"Input@GRAD": _assemble_grads(
+        in_names, g_init, g_reads, skip=synthesized)}
 
 
 # ---------------------------------------------------------------------------
@@ -650,3 +798,12 @@ def dynamic_recurrent_op(ctx, ins, attrs):
         y_bt = jnp.swapaxes(y, 0, 1)  # [B,T,*]
         env[out_name] = padded_to_seq(y_bt, lengths, ntokens)
     return {}
+
+
+# state vars first materialized INSIDE a conditional block have no value at
+# op entry: fetch inputs lazily (missing -> None), like the reader ops
+_registry_mod.get_op_def("conditional_block").lazy_inputs = True
+_registry_mod.get_op_def("conditional_block_grad").lazy_inputs = True
+# while_grad: InitStates/InputSnapshots entries for sub-block-local names
+# are never materialized (their snapshot is None by construction)
+_registry_mod.get_op_def("while_grad").lazy_inputs = True

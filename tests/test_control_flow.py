@@ -223,3 +223,178 @@ def test_while_training_converges():
             lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
             losses.append(float(np.asarray(lv)))
     assert losses[-1] < 0.5 * losses[0], losses
+
+
+def test_conditional_block_grad_both_branches():
+    """r5: gradients flow through conditional_block (reference
+    ConditionalBlockGradOp, conditional_block_op.cc) — the same silent
+    [None] class while_grad closed. Taken branch: vjp through the block;
+    untaken: the output keeps its pre-op value, so dx is zero."""
+    from paddle_tpu import backward
+
+    def run(flag_val):
+        main, startup = Program(), Program()
+        with fluid.unique_name.guard(), program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            x.stop_gradient = False
+            flag = fluid.layers.fill_constant(
+                shape=[1], dtype="bool", value=flag_val)
+            out = fluid.layers.fill_constant(
+                shape=[1, 4], dtype="float32", value=1.0)
+            cb = fluid.layers.ConditionalBlock(
+                [flag], is_scalar_condition=True)
+            with cb.block():
+                fluid.layers.assign(fluid.layers.scale(x, scale=3.0), out)
+            loss = fluid.layers.mean(out)
+            g, = backward.calc_gradient(loss, [x])
+        assert g is not None
+        exe = fluid.Executor(fluid.CPUPlace())
+        s = fluid.Scope()
+        with fluid.scope_guard(s):
+            exe.run(startup)
+            lv, gv = exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
+                             fetch_list=[loss, g])
+        return float(np.asarray(lv).reshape(-1)[0]), np.asarray(gv)
+
+    l_t, g_t = run(True)
+    assert abs(l_t - 3.0) < 1e-5
+    np.testing.assert_allclose(g_t, np.full((1, 4), 0.75), rtol=1e-6)
+    l_f, g_f = run(False)
+    assert abs(l_f - 1.0) < 1e-5
+    np.testing.assert_allclose(g_f, np.zeros((1, 4)), atol=1e-7)
+
+
+def test_conditional_block_grad_overwrite_without_read():
+    """Out vars the block OVERWRITES but never reads: the pre-op producer
+    must get where(pred, 0, dOut) — taken kills the pre-grad entirely,
+    untaken passes it through (r5 review failure case)."""
+    from paddle_tpu import backward
+
+    def run(flag_val):
+        main, startup = Program(), Program()
+        with fluid.unique_name.guard(), program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            z = fluid.layers.data(name="z", shape=[4], dtype="float32")
+            x.stop_gradient = False
+            z.stop_gradient = False
+            y = fluid.layers.scale(x, scale=2.0)
+            flag = fluid.layers.fill_constant(
+                shape=[1], dtype="bool", value=flag_val)
+            cb = fluid.layers.ConditionalBlock(
+                [flag], is_scalar_condition=True)
+            with cb.block():
+                fluid.layers.assign(fluid.layers.scale(z, scale=3.0), y)
+            loss = fluid.layers.mean(y)
+            gx, gz = backward.calc_gradient(loss, [x, z])
+        exe = fluid.Executor(fluid.CPUPlace())
+        s = fluid.Scope()
+        with fluid.scope_guard(s):
+            exe.run(startup)
+            outs = exe.run(
+                main, feed={"x": np.ones((1, 4), np.float32),
+                            "z": np.ones((1, 4), np.float32)},
+                fetch_list=[gx, gz])
+        return np.asarray(outs[0]), np.asarray(outs[1])
+
+    gx_t, gz_t = run(True)
+    np.testing.assert_allclose(gx_t, np.zeros((1, 4)), atol=1e-7)
+    np.testing.assert_allclose(gz_t, np.full((1, 4), 0.75), rtol=1e-6)
+    gx_f, gz_f = run(False)
+    np.testing.assert_allclose(gx_f, np.full((1, 4), 0.5), rtol=1e-6)
+    np.testing.assert_allclose(gz_f, np.zeros((1, 4)), atol=1e-7)
+
+
+def test_conditional_block_grad_var_materialized_inside():
+    """A state var FIRST materialized inside the block (the Switch/IfElse
+    accumulator idiom): lazy Input fetch keeps the forward working and the
+    grad synthesizes the zero 'false branch' init the forward would have
+    produced."""
+    from paddle_tpu import backward
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        flag = fluid.layers.fill_constant(shape=[1], dtype="bool",
+                                          value=True)
+        out = fluid.layers.create_tensor(dtype="float32")
+        cb = fluid.layers.ConditionalBlock([flag], is_scalar_condition=True)
+        with cb.block():
+            fluid.layers.assign(fluid.layers.scale(x, scale=3.0), out)
+        loss = fluid.layers.mean(out)
+        g, = backward.calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        gv, = exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
+                      fetch_list=[g])
+    np.testing.assert_allclose(np.asarray(gv), np.full((1, 4), 0.75),
+                               rtol=1e-6)
+
+
+def test_conditional_block_grad_ignores_later_overwrites():
+    """The grad replay must see ENTRY-time values of the block's reads
+    (InputSnapshots), not whatever a later forward op wrote over them:
+    out = y*y inside the block, y := 100 after it — dx must still be 2x."""
+    from paddle_tpu import backward
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        y = fluid.layers.scale(x, scale=2.0)
+        flag = fluid.layers.fill_constant(shape=[1], dtype="bool",
+                                          value=True)
+        out = fluid.layers.fill_constant(shape=[1, 4], dtype="float32",
+                                         value=0.0)
+        cb = fluid.layers.ConditionalBlock([flag], is_scalar_condition=True)
+        with cb.block():
+            fluid.layers.assign(fluid.layers.elementwise_mul(y, y), out)
+        fluid.layers.assign(fluid.layers.fill_constant(
+            shape=[1, 4], dtype="float32", value=100.0), y)
+        loss = fluid.layers.mean(out)
+        g, = backward.calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        gv, = exe.run(main, feed={"x": np.full((1, 4), 3.0, np.float32)},
+                      fetch_list=[g])
+    np.testing.assert_allclose(np.asarray(gv), np.full((1, 4), 6.0),
+                               rtol=1e-5)
+
+
+def test_ifelse_grads_select_taken_branch():
+    """IfElse (built on ConditionalBlock) trains: branch outputs are
+    sub-block-created vars, and the cotangent routes through the block of
+    the branch that actually ran."""
+    from paddle_tpu import backward
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        c = fluid.layers.data(name="c", shape=[1], dtype="float32")
+        cond = fluid.layers.less_than(x=c, y=fluid.layers.fill_constant(
+            shape=[1], dtype="float32", value=0.5))
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(fluid.layers.scale(ie.input(x), scale=2.0))
+        with ie.false_block():
+            ie.output(fluid.layers.scale(ie.input(x), scale=5.0))
+        out = ie()[0]
+        loss = fluid.layers.mean(out)
+        g, = backward.calc_gradient(loss, [x])
+    assert g is not None
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        for cv, expect in ((0.0, 0.5), (1.0, 1.25)):  # chosen scale / 4
+            gv, = exe.run(main,
+                          feed={"x": np.ones((1, 4), np.float32),
+                                "c": np.full((1, 1), cv, np.float32)},
+                          fetch_list=[g])
+            np.testing.assert_allclose(
+                np.asarray(gv), np.full((1, 4), expect), rtol=1e-5)
