@@ -1225,7 +1225,8 @@ for _t in (
     "prefetch", "listen_and_serv",
     "channel_create", "channel_send", "channel_recv", "channel_close",
     "go", "select", "while", "conditional_block",
-    "write_to_array", "read_from_array", "lod_tensor_to_array",
+    "write_to_array", "read_from_array", "read_from_array_grad",
+    "lod_tensor_to_array",
     "array_to_lod_tensor", "lod_rank_table", "shrink_rnn_memory",
     "reorder_lod_tensor_by_rank", "beam_search", "beam_search_decode",
     "init_sparse_table", "lookup_sparse_table", "split_ids", "merge_ids",
@@ -1249,6 +1250,13 @@ def _conditional_block_grad(ctx):
         d = ctx.input_dim("Input", i)
         if d is not None:
             ctx.set_output_dim("Input@GRAD", d, i)
+
+
+@register_infer_shape("write_to_array_grad")
+def _write_to_array_grad(ctx):
+    d = ctx.input_dim("X")
+    if d is not None:
+        ctx.set_output_dim("X@GRAD", d)
 
 
 @register_infer_shape("lod_array_length", "max_sequence_len")

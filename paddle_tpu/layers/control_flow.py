@@ -87,13 +87,19 @@ def array_write(x, i, array=None):
     helper = LayerHelper("array_write", **locals())
     if array is None:
         array = create_array(x.dtype)
+    # carry the element shape so array_read outputs stay shape-inferable
+    # (downstream fc/reshape need it; all slots share one element shape
+    # under the static-shape trace anyway)
+    if getattr(array, "shape", None) is None and x.shape is not None:
+        array.shape = x.shape
     helper.append_op("write_to_array", {"X": [x], "I": [i]}, {"Out": [array]})
     return array
 
 
 def array_read(array, i):
     helper = LayerHelper("array_read", **locals())
-    out = helper.create_tmp_variable(dtype=array.dtype)
+    out = helper.create_tmp_variable(dtype=array.dtype,
+                                     shape=getattr(array, "shape", None))
     helper.append_op("read_from_array", {"X": [array], "I": [i]}, {"Out": [out]})
     return out
 
@@ -178,7 +184,8 @@ class BlockGuard:
         return exc_type is None
 
 
-def _sub_block_interface(parent_block, sub_block, snap_suffix):
+def _sub_block_interface(parent_block, sub_block, snap_suffix,
+                         all_writes=False):
     """Shared by While and ConditionalBlock: derive the sub-block's
     parent-visible reads and writes, undo constant-initializer
     stop_gradient flags on rewritten float vars (a var the block REWRITES
@@ -200,11 +207,18 @@ def _sub_block_interface(parent_block, sub_block, snap_suffix):
     for op in sub_block.ops:
         x_names.update(op.input_arg_names())
         inner.update(op.output_arg_names())
-    # ALL written names are outputs: the flat trace env makes sub-created
-    # vars observable downstream (that is how IfElse branch outputs reach
-    # the merge), so the cotangent must be able to route back through the
-    # op. Sub-created ones get a parent-block var desc.
-    out_names = sorted(n for n in inner if n)
+    if all_writes:
+        # ALL written names are outputs: the flat trace env makes
+        # sub-created vars observable downstream (how IfElse branch
+        # outputs reach the merge), so the cotangent must route back
+        # through the op. Sub-created ones get a parent-block var desc.
+        out_names = sorted(n for n in inner if n)
+    else:
+        # While: loop temps are not observable after the loop (the carry
+        # exports only entry-materialized state), so declaring them would
+        # be dead IR that scales with body size
+        out_names = sorted(
+            n for n in inner if parent_block.has_var_recursive(n))
     in_names = sorted(n for n in x_names if parent_block.has_var_recursive(n))
     const_init_types = {
         "fill_constant", "fill_constant_batch_size_like",
@@ -333,15 +347,21 @@ class ConditionalBlock:
         # not taken: identity to the init). Inputs are fetched lazily:
         # a state var first materialized INSIDE the block has no value yet.
         in_names, out_names, init_names, in_snaps = _sub_block_interface(
-            parent_block, sub_block, "@COND_INIT")
+            parent_block, sub_block, "@COND_INIT", all_writes=True)
         extra = sorted(set(out_names) - set(in_names))
         in_names = in_names + extra  # snapshot lists stay aligned
         in_snaps = in_snaps + [""] * len(extra)
+        cond_snaps = []
+        for v in self.inputs:
+            snap = unique_name.generate(v.name + "@COND_INIT_X")
+            parent_block.create_var(name=snap, shape=v.shape, dtype=v.dtype)
+            cond_snaps.append(snap)
         parent_block.append_op(
             "conditional_block",
             {"X": self.inputs, "Input": in_names},
             {"Out": out_names, "InitStates": init_names,
-             "InputSnapshots": in_snaps, "Scope": []},
+             "InputSnapshots": in_snaps, "CondSnapshots": cond_snaps,
+             "Scope": []},
             {"sub_block": sub_block, "is_scalar_condition": self.is_scalar_condition},
         )
 

@@ -61,7 +61,8 @@ for _name in ("less_than", "less_equal", "greater_than", "greater_equal",
 set_stop_gradient_outputs(
     "while", ["InitStates", "InputSnapshots", "StepScopes"])
 set_stop_gradient_outputs(
-    "conditional_block", ["InitStates", "InputSnapshots", "Scope"])
+    "conditional_block",
+    ["InitStates", "InputSnapshots", "CondSnapshots", "Scope"])
 from ..core import registry as _registry_mod  # noqa: E402
 
 
@@ -279,13 +280,7 @@ def conditional_block_op(ctx, ins, attrs):
     else:
         pred = jnp.all(cond)
 
-    written = []
-    seen = set()
-    for sub_op in block.ops:
-        for n in sub_op.output_arg_names():
-            if n and n not in seen:
-                seen.add(n)
-                written.append(n)
+    written = _while_written(block)
 
     def true_fn(_):
         local = dict(env)
@@ -316,6 +311,9 @@ def conditional_block_op(ctx, ins, attrs):
         ret["InitStates"] = [inits.get(n) for n in out_names]
     if op.output("InputSnapshots"):
         ret["InputSnapshots"] = [entry.get(n) for n in op.input("Input")]
+    if op.output("CondSnapshots"):
+        # the predicate too must replay from entry-time values
+        ret["CondSnapshots"] = [env.get(n) for n in op.input("X")]
     return ret
 
 
@@ -334,7 +332,7 @@ def conditional_block_grad_maker(op, gout, gin):
     return [dict(
         type="conditional_block_grad",
         inputs={
-            "X": op.input("X"),
+            "X": (op.output("CondSnapshots") or op.input("X")),
             "Input": op.input("Input"),
             "InitStates": op.output("InitStates"),
             "InputSnapshots": op.output("InputSnapshots") or [],
@@ -497,6 +495,69 @@ def read_from_array_op(ctx, ins, attrs):
     arr = first(ins, "X")
     i = first(ins, "I")
     return out(Out=arr.read(i))
+
+
+# -- tensor-array gradients (reference tensor_array_read_write.cc: the
+# grad of a write READS the grad array at I; the grad of a read WRITES
+# (accumulates) dOut into the grad array at I) ------------------------------
+@register_grad_maker("write_to_array")
+def write_to_array_grad_maker(op, gout, gin):
+    return [dict(
+        type="write_to_array_grad",
+        inputs={"OutGrad": gout["Out"], "I": op.input("I"),
+                "X": op.input("X")},
+        outputs={"X@GRAD": gin.get("X", [])},
+        attrs={},
+    )]
+
+
+@register_op("write_to_array_grad", lod_aware=True)
+def write_to_array_grad_op(ctx, ins, attrs):
+    garr = first(ins, "OutGrad")
+    i = first(ins, "I")
+    x = first(ins, "X")
+    idx = _concrete_index(i)
+    if isinstance(garr, TensorArray) and idx < len(garr.items) \
+            and garr.items[idx] is not None:
+        g = garr.items[idx]
+        # CONSUME the slot: reverse order visits the program's LAST write
+        # first; an earlier write the forward overwrote must see zero
+        # (its value never reached any read)
+        garr.items[idx] = None
+    else:
+        g = jnp.zeros(jnp.shape(x), jnp.asarray(x).dtype)  # never read
+    return {"X@GRAD": [g]}
+
+
+@register_grad_maker("read_from_array")
+def read_from_array_grad_maker(op, gout, gin):
+    return [dict(
+        type="read_from_array_grad",
+        inputs={"OutGrad": gout["Out"], "I": op.input("I")},
+        outputs={"X@GRAD": gin.get("X", [])},
+        attrs={},
+    )]
+
+
+@register_op("read_from_array_grad", lod_aware=True)
+def read_from_array_grad_op(ctx, ins, attrs):
+    """Accumulates into the grad ARRAY in place (multiple reads of the
+    same slot sum their cotangents), mirroring write_to_array's in-place
+    env update."""
+    op = ctx.current_op
+    env = ctx.env
+    g = first(ins, "OutGrad")
+    i = first(ins, "I")
+    out_name = op.output("X@GRAD")[0]
+    arr = env.get(out_name)
+    if not isinstance(arr, TensorArray):
+        arr = TensorArray()
+    idx = _concrete_index(i)
+    while len(arr.items) <= idx:
+        arr.items.append(None)
+    arr.items[idx] = g if arr.items[idx] is None else arr.items[idx] + g
+    env[out_name] = arr
+    return {}
 
 
 @register_op("lod_array_length")

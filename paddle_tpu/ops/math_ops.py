@@ -96,7 +96,23 @@ def sum_op(ctx, ins, attrs):
     duplicates left for the consumer to merge); a dense/sparse mix densifies."""
     xs = many(ins, "X")
     from ..core.selected_rows import SelectedRows
+    from .control_flow_ops import TensorArray
 
+    if any(isinstance(x, TensorArray) for x in xs):
+        # tensor-array grad accumulation (two reads of one array): merge
+        # per slot, None-aware — a slot only one part touched rides through
+        merged = TensorArray()
+        for x in xs:
+            if not isinstance(x, TensorArray):
+                raise TypeError(
+                    "sum: cannot mix tensor arrays with dense tensors")
+            for idx, item in enumerate(x.items):
+                while len(merged.items) <= idx:
+                    merged.items.append(None)
+                if item is not None:
+                    merged.items[idx] = item if merged.items[idx] is None \
+                        else merged.items[idx] + item
+        return out(Out=merged)
     if any(isinstance(x, SelectedRows) for x in xs):
         if all(isinstance(x, SelectedRows) for x in xs):
             rows = jnp.concatenate([jnp.asarray(x.rows).reshape(-1) for x in xs])

@@ -398,3 +398,102 @@ def test_ifelse_grads_select_taken_branch():
                           fetch_list=[g])
             np.testing.assert_allclose(
                 np.asarray(gv), np.full((1, 4), expect), rtol=1e-5)
+
+
+def test_tensor_array_grads():
+    """r5: backprop through write_to_array/read_from_array (reference
+    tensor_array_read_write.cc grads: a write's grad READS the grad array;
+    a read's grad ACCUMULATES into it). Covers double reads of one slot
+    (cotangents sum) and a never-read slot (zero grad)."""
+    from paddle_tpu import backward
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        z = fluid.layers.data(name="z", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        z.stop_gradient = False
+        i0 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        i1 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=1)
+        arr = fluid.layers.array_write(fluid.layers.scale(x, scale=2.0), i0)
+        fluid.layers.array_write(fluid.layers.scale(z, scale=7.0), i1,
+                                 array=arr)
+        a = fluid.layers.array_read(arr, i0)
+        b = fluid.layers.array_read(arr, i0)  # slot 0 read TWICE; 1 never
+        loss = fluid.layers.mean(fluid.layers.sums([a, b]))
+        gx, gz = backward.calc_gradient(loss, [x, z])
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        outs = exe.run(main, feed={"x": np.ones((1, 4), np.float32),
+                                   "z": np.ones((1, 4), np.float32)},
+                       fetch_list=[gx, gz])
+    np.testing.assert_allclose(np.asarray(outs[0]), np.full((1, 4), 1.0),
+                               rtol=1e-6)  # d mean(2·2x)/dx
+    np.testing.assert_allclose(np.asarray(outs[1]), np.zeros((1, 4)),
+                               atol=1e-7)  # slot 1 never read
+
+
+def test_conditional_block_grad_predicate_snapshot():
+    """The grad op replays under the ENTRY-time predicate (CondSnapshots):
+    a condition var overwritten after the block must not flip the
+    differentiated branch."""
+    from paddle_tpu import backward
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        flag = fluid.layers.fill_constant(shape=[1], dtype="bool",
+                                          value=True)
+        out_v = fluid.layers.fill_constant(shape=[1, 4], dtype="float32",
+                                           value=0.0)
+        cb = fluid.layers.ConditionalBlock([flag], is_scalar_condition=True)
+        with cb.block():
+            fluid.layers.assign(fluid.layers.scale(x, scale=3.0), out_v)
+        fluid.layers.assign(fluid.layers.fill_constant(
+            shape=[1], dtype="bool", value=False), flag)
+        loss = fluid.layers.mean(out_v)
+        g, = backward.calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        gv, = exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
+                      fetch_list=[g])
+    np.testing.assert_allclose(np.asarray(gv), np.full((1, 4), 0.75),
+                               rtol=1e-6)
+
+
+def test_tensor_array_overwritten_slot_dead_write_zero_grad():
+    """A slot written twice: the dead (overwritten) write's source gets
+    ZERO gradient — write_to_array_grad consumes the slot cotangent so
+    only the live write sees it."""
+    from paddle_tpu import backward
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        z = fluid.layers.data(name="z", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        z.stop_gradient = False
+        i0 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        arr = fluid.layers.array_write(
+            fluid.layers.scale(x, scale=2.0), i0)
+        fluid.layers.array_write(fluid.layers.scale(z, scale=7.0), i0,
+                                 array=arr)
+        a = fluid.layers.array_read(arr, i0)
+        loss = fluid.layers.mean(a)
+        gx, gz = backward.calc_gradient(loss, [x, z])
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        outs = exe.run(main, feed={"x": np.ones((1, 4), np.float32),
+                                   "z": np.ones((1, 4), np.float32)},
+                       fetch_list=[gx, gz])
+    np.testing.assert_allclose(np.asarray(outs[0]), np.zeros((1, 4)),
+                               atol=1e-7)
+    np.testing.assert_allclose(np.asarray(outs[1]),
+                               np.full((1, 4), 1.75), rtol=1e-6)
