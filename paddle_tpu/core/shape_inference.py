@@ -86,7 +86,9 @@ class InferShapeContext:
         v = self._var(names[i])
         if v is None or dim is None:
             return
-        new = [int(d) for d in dim]
+        # None (unknown, e.g. a memory var's lazy batch) maps to the
+        # dynamic dim like -1 does
+        new = [-1 if d is None else int(d) for d in dim]
         # -1 means "unknown to this contract": keep the layer's existing
         # more-specific dim rather than clobbering it (a -1 written into a
         # parameter's input chain otherwise propagates into weight shapes)

@@ -184,6 +184,29 @@ class BlockGuard:
         return exc_type is None
 
 
+def _scan_sub_block(sub_block):
+    """(reads, writes) name sets of a sub-block — the one definition used
+    by the While/ConditionalBlock interface and the recurrent closure."""
+    x_names, inner = set(), set()
+    for op in sub_block.ops:
+        x_names.update(op.input_arg_names())
+        inner.update(op.output_arg_names())
+    return x_names, inner
+
+
+def _sub_block_closure(parent_block, sub_block, exclude):
+    """Parent-visible names the sub-block READS that are not otherwise
+    declared on the op: the recurrent family must list them as inputs so
+    the auto-vjp tracks them — undeclared closure reads (weights!) would
+    silently get ZERO gradients. Read-AND-written names stay in (their
+    first read is of the parent value)."""
+    x_names, _inner = _scan_sub_block(sub_block)
+    return sorted(
+        n for n in x_names
+        if n and n not in exclude
+        and parent_block.has_var_recursive(n))
+
+
 def _sub_block_interface(parent_block, sub_block, snap_suffix,
                          all_writes=False):
     """Shared by While and ConditionalBlock: derive the sub-block's
@@ -203,10 +226,7 @@ def _sub_block_interface(parent_block, sub_block, snap_suffix,
     runtime cost."""
     from .. import unique_name
 
-    x_names, inner = set(), set()
-    for op in sub_block.ops:
-        x_names.update(op.input_arg_names())
-        inner.update(op.output_arg_names())
+    x_names, inner = _scan_sub_block(sub_block)
     if all_writes:
         # ALL written names are outputs: the flat trace env makes
         # sub-created vars observable downstream (how IfElse branch
@@ -602,11 +622,19 @@ class StaticRNN:
             for o in self.outputs
         ]
         self._outputs_vars = step_outs
+        # boots stay ELIGIBLE: a boot var read directly inside the step
+        # (beyond its carry role) needs the closure path for that read's
+        # gradient; double declaration sums via the multi-slot machinery
+        closure = _sub_block_closure(
+            parent_block, sub_block,
+            exclude=set([v.name for v in inner_inputs]
+                        + [v.name for v in pre_mems]))
         parent_block.append_op(
             "recurrent",
             {
                 "inputs": step_inputs,
                 "initial_states": boots,
+                "Closure": closure,
             },
             {"outputs": step_outs, "step_scopes": []},
             {
@@ -615,6 +643,7 @@ class StaticRNN:
                 "states": [v.name for v in new_mems],
                 "step_input_names": [v.name for v in inner_inputs],
                 "step_output_names": [o.name for o in self.outputs],
+                "closure_names": closure,
             },
         )
 
@@ -717,16 +746,24 @@ class DynamicRNN:
             for o in self.outputs
         ]
         self._outputs_vars = outs
+        closure = _sub_block_closure(
+            parent_block, sub_block,
+            exclude=set([i.name for _, i in self.inputs]
+                        + [m[3].name for m in self.memories]
+                        + [v.name for v in self.static_inputs]))
         parent_block.append_op(
             "dynamic_recurrent",
             {
                 "inputs": [x for x, _ in self.inputs],
                 "static_inputs": self.static_inputs,
                 "initial_states": [m[0] for m in self.memories if m[0] is not None],
+                "Closure": closure,
             },
             {"outputs": outs},
             {
                 "sub_block": sub_block,
+                "closure_names": closure,
+                "static_input_names": [v.name for v in self.static_inputs],
                 "step_input_names": [i.name for _, i in self.inputs],
                 "mem_init_names": [m[0].name if m[0] is not None else "" for m in self.memories],
                 "mem_shapes": [list(m[1]) if m[1] else [] for m in self.memories],

@@ -774,19 +774,27 @@ def get_places_op(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 @register_op("recurrent", lod_aware=True)
 def recurrent_op(ctx, ins, attrs):
-    op = ctx.current_op
+    """StaticRNN body as one lax.scan. Reads every operand from `ins`
+    (inputs, boots, AND the Closure slot carrying the sub-block's
+    parent-visible reads — weights included) and RETURNS outputs instead
+    of writing the env, so the auto-vjp (<recurrent>_grad) tracks the full
+    data dependence: undeclared closure reads would silently get zero
+    gradients (reference recurrent_op.cc grad replays step scopes)."""
     env = ctx.env
     block = attrs["sub_block"]
     step_input_names = attrs["step_input_names"]
     ex_states = attrs["ex_states"]
     states = attrs["states"]
     step_output_names = attrs["step_output_names"]
+    closure_names = attrs.get("closure_names", [])
 
-    xs = [env[n] for n in op.input("inputs")]  # each [T, ...]
-    boots = [env[n] for n in op.input("initial_states")]
+    xs = list(many(ins, "inputs"))  # each [T, ...]
+    boots = list(many(ins, "initial_states"))
+    closure = dict(zip(closure_names, ins.get("Closure", [])))
 
     def body(carry, x_t):
         local = dict(env)
+        local.update({n: v for n, v in closure.items() if v is not None})
         local.update(dict(zip(ex_states, carry)))
         local.update(dict(zip(step_input_names, x_t)))
         ctx.run_block(block, local)
@@ -795,8 +803,7 @@ def recurrent_op(ctx, ins, attrs):
         return new_carry, ys
 
     _, ys = lax.scan(body, tuple(boots), tuple(xs))
-    env.update(dict(zip(op.output("outputs"), ys)))
-    return {}
+    return {"outputs": list(ys)}
 
 
 @register_op("dynamic_recurrent", lod_aware=True)
@@ -809,7 +816,6 @@ def dynamic_recurrent_op(ctx, ins, attrs):
     """
     from .sequence_ops import seq_to_padded, padded_to_seq
 
-    op = ctx.current_op
     env = ctx.env
     block = attrs["sub_block"]
     step_input_names = attrs["step_input_names"]
@@ -820,7 +826,13 @@ def dynamic_recurrent_op(ctx, ins, attrs):
     mem_values = attrs["mem_values"]
     step_output_names = attrs["step_output_names"]
 
-    seq_ins = [env[n] for n in op.input("inputs")]
+    seq_ins = list(many(ins, "inputs"))
+    closure = dict(zip(attrs.get("closure_names", []),
+                       ins.get("Closure", [])))
+    # declared static inputs must ALSO come from ins or their gradients
+    # are silently zero (the same undeclared-read class as Closure)
+    closure.update(zip(attrs.get("static_input_names", []),
+                       ins.get("static_inputs", [])))
     assert seq_ins and isinstance(seq_ins[0], SeqTensor), "DynamicRNN needs ragged inputs"
     lengths = seq_ins[0].lengths
     B = int(lengths.shape[0])
@@ -828,10 +840,13 @@ def dynamic_recurrent_op(ctx, ins, attrs):
     T = ntokens  # conservative static bound; bucketing trims this upstream
     padded = [jnp.swapaxes(seq_to_padded(s, T), 0, 1) for s in seq_ins]  # [T,B,*]
 
+    declared_boots = dict(zip(
+        [n for n in mem_init_names if n], many(ins, "initial_states")))
     boots = []
     for i, name in enumerate(pre_mem_names):
         if mem_init_names[i]:
-            boots.append(env[mem_init_names[i]])
+            boots.append(declared_boots.get(mem_init_names[i],
+                                            env.get(mem_init_names[i])))
         else:
             shape = [B] + list(mem_shapes[i])
             boots.append(jnp.full(shape, mem_values[i], padded[0].dtype))
@@ -841,6 +856,7 @@ def dynamic_recurrent_op(ctx, ins, attrs):
     def body(carry, inp):
         x_ts, t = inp
         local = dict(env)
+        local.update({n: v for n, v in closure.items() if v is not None})
         local.update(dict(zip(pre_mem_names, carry)))
         local.update(dict(zip(step_input_names, x_ts)))
         ctx.run_block(block, local)
@@ -854,11 +870,11 @@ def dynamic_recurrent_op(ctx, ins, attrs):
         return tuple(new_carry), ys
 
     _, ys = lax.scan(body, tuple(boots), (tuple(padded), ts))
-    # re-raggedify each output: ys[i] is [T,B,*] -> SeqTensor aligned to input
-    for out_name, y in zip(op.output("outputs"), ys):
-        y_bt = jnp.swapaxes(y, 0, 1)  # [B,T,*]
-        env[out_name] = padded_to_seq(y_bt, lengths, ntokens)
-    return {}
+    # re-raggedify each output: ys[i] is [T,B,*] -> SeqTensor aligned to
+    # input; RETURNED (not env side-effect) so the auto-vjp tracks it
+    outs = [padded_to_seq(jnp.swapaxes(y, 0, 1), lengths, ntokens)
+            for y in ys]
+    return {"outputs": outs}
 
 
 # state vars first materialized INSIDE a conditional block have no value at

@@ -497,3 +497,119 @@ def test_tensor_array_overwritten_slot_dead_write_zero_grad():
                                atol=1e-7)
     np.testing.assert_allclose(np.asarray(outs[1]),
                                np.full((1, 4), 1.75), rtol=1e-6)
+
+
+def test_static_rnn_grads_exact():
+    """r5: the recurrent family reads operands from ins and RETURNS
+    outputs, so the auto-vjp tracks the full data dependence — previously
+    the env-closure dataflow made every StaticRNN gradient silently ZERO.
+    h_t = h_{t-1} + 2 x_t; analytic d mean(out)/dx_t = 2 (T-t) / numel."""
+    from paddle_tpu import backward
+
+    T, B, D = 3, 2, 4
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, B, D],
+                              append_batch_size=False, dtype="float32")
+        x.stop_gradient = False
+        init = fluid.layers.fill_constant(shape=[B, D], dtype="float32",
+                                          value=0.0)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h = rnn.memory(init=init)
+            nh = fluid.layers.elementwise_add(
+                h, fluid.layers.scale(x_t, scale=2.0))
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        out_seq = rnn()
+        loss = fluid.layers.mean(out_seq)
+        g, = backward.calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        gv, = exe.run(main, feed={"x": np.ones((T, B, D), np.float32)},
+                      fetch_list=[g])
+    got = np.asarray(gv)
+    want = np.stack([np.full((B, D), 2.0 * (T - t) / (T * B * D),
+                             np.float32) for t in range(T)])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_static_rnn_closure_weight_trains():
+    """Weights read inside the rnn step (the Closure slot) receive real
+    gradients: an SGD loop through a StaticRNN with an fc cell converges
+    on a fixed batch."""
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        T, B, D = 3, 4, 6
+        x = fluid.layers.data(name="x", shape=[T, B, D],
+                              append_batch_size=False, dtype="float32")
+        y = fluid.layers.data(name="y", shape=[B, D],
+                              append_batch_size=False, dtype="float32")
+        init = fluid.layers.fill_constant(shape=[B, D], dtype="float32",
+                                          value=0.0)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h = rnn.memory(init=init)
+            nh = fluid.layers.fc(input=fluid.layers.elementwise_add(h, x_t),
+                                 size=D, act="tanh")
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        out_seq = rnn()
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.reduce_mean(out_seq, dim=0) - y))
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+    rs = np.random.RandomState(8)
+    xv = rs.randn(T, B, D).astype("float32")
+    yv = np.tanh(rs.randn(B, D)).astype("float32") * 0.5
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        for _ in range(60):
+            lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    # the random target is not exactly representable; a steady ~4x
+    # reduction proves the closure weights receive real gradients
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+def test_dynamic_rnn_static_input_grads_exact():
+    """r5: DynamicRNN static_input values route through ins (not env), so
+    their gradients are real — with lengths [2, 1], last state per seq is
+    len * rowmean(w), giving d loss/dw = 0.25 exactly."""
+    from paddle_tpu import backward
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[1], lod_level=1,
+                              dtype="float32")
+        w = fluid.layers.data(name="w", shape=[2, 3],
+                              append_batch_size=False, dtype="float32")
+        w.stop_gradient = False
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            drnn.step_input(x)
+            s = drnn.static_input(w)
+            mem = drnn.memory(shape=[3], value=0.0)
+            nh = fluid.layers.elementwise_add(
+                mem, fluid.layers.reduce_mean(s, dim=0))
+            drnn.update_memory(mem, nh)
+            drnn.output(nh)
+        outv = drnn()
+        loss = fluid.layers.mean(fluid.layers.sequence_pool(outv, "last"))
+        g, = backward.calc_gradient(loss, [w])
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        lt = fluid.create_lod_tensor([[1.0, 2.0], [3.0]], None,
+                                     fluid.CPUPlace())
+        gv, = exe.run(main, feed={"x": lt, "w": np.ones((2, 3), np.float32)},
+                      fetch_list=[g])
+    np.testing.assert_allclose(np.asarray(gv), np.full((2, 3), 0.25),
+                               rtol=1e-5)
