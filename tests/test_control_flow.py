@@ -613,3 +613,37 @@ def test_dynamic_rnn_static_input_grads_exact():
                       fetch_list=[g])
     np.testing.assert_allclose(np.asarray(gv), np.full((2, 3), 0.25),
                                rtol=1e-5)
+
+
+def test_switch_grads_follow_active_case():
+    """Switch (stacked conditional blocks): gradients route through the
+    case that actually ran, both for an explicit case and the default."""
+    from paddle_tpu import backward
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        c = fluid.layers.data(name="c", shape=[1], dtype="float32")
+        x.stop_gradient = False
+        res = fluid.layers.fill_constant(shape=[1, 4], dtype="float32",
+                                         value=0.0)
+        half = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                          value=0.5)
+        with fluid.layers.Switch() as switch:
+            with switch.case(fluid.layers.less_than(x=c, y=half)):
+                fluid.layers.assign(fluid.layers.scale(x, scale=2.0), res)
+            with switch.default():
+                fluid.layers.assign(fluid.layers.scale(x, scale=5.0), res)
+        loss = fluid.layers.mean(res)
+        g, = backward.calc_gradient(loss, [x])
+    assert g is not None
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        for cv, expect in ((0.0, 0.5), (1.0, 1.25)):
+            gv, = exe.run(main, feed={"x": np.ones((1, 4), np.float32),
+                                      "c": np.full((1, 1), cv, np.float32)},
+                          fetch_list=[g])
+            np.testing.assert_allclose(
+                np.asarray(gv), np.full((1, 4), expect), rtol=1e-5)
