@@ -26,22 +26,26 @@ up here, r4 VERDICT weak #5):
     platform block_until_ready does not reliably block — and the measured
     window subtracts the measured scalar round-trip latency.
 
-Pipeline numbers:
-  * pipeline_images_per_sec — the REAL end-to-end input path: native
-    RecordIO scan -> uint8 decode on a prefetch thread -> DeviceChunkFeeder
-    (stacks K batches, device_puts each chunk) -> Executor.run(iters=K).
-    On this bench setup the host->device link is a SHARED TUNNEL whose
-    bandwidth fluctuates ~50x between runs (measured 20 MB/s - 1.6 GB/s for
-    the same chunk), so the JSON also reports pipeline_link_MBps (measured
-    during the run) and pipeline_link_bound_img_s (the ceiling that
-    bandwidth implies) for interpretation.
-  * pipeline_hostpath_img_s — the SAME reader -> decode -> stack ->
-    DeviceChunkFeeder -> iters=K machinery, with only the device_put
-    swapped for pre-staged device-resident chunks (DeviceChunkFeeder
-    stage_fn): measures the framework's own pipeline overhead with the
-    tunnel taken off the critical path (r4 VERDICT weak #3 / task 4 — on a
-    real TPU host the link is PCIe-fast, so THIS is the
-    deployment-representative number).
+Pipeline numbers (datapipe subsystem):
+  * pipeline_images_per_sec — the REAL end-to-end input path, now built on
+    paddle_tpu.datapipe: sharded native RecordIO source -> ParallelMap
+    uint8 decode workers -> AsyncDeviceFeeder (stacks K batches, then
+    TRANSFER_THREADS worker threads device_put whole chunks CONCURRENTLY,
+    capacity-bounded) -> Executor.run(iters=K). Parallel chunk transfers
+    are the big lever on this bench setup: the host->device link is a
+    SHARED TUNNEL whose single-stream bandwidth fluctuates ~50x between
+    runs (measured 20 MB/s - 1.6 GB/s for the same chunk), and multiple
+    in-flight streams multiply the achieved aggregate. The JSON also
+    reports pipeline_link_MBps (single-stream, measured during the run)
+    and pipeline_link_bound_img_s (the ceiling ONE stream implies) for
+    interpretation, plus per-stage busy/wait fractions from
+    DataPipe.stats() under pipeline_stage_*.
+  * pipeline_hostpath_img_s — the SAME source -> decode -> stack ->
+    feeder -> iters=K machinery, with only the device_put swapped for
+    pre-staged device-resident chunks (AsyncDeviceFeeder stage_fn):
+    measures the framework's own pipeline overhead with the tunnel taken
+    off the critical path (on a real TPU host the link is PCIe-fast, so
+    THIS is the deployment-representative number).
 """
 
 import json
@@ -72,6 +76,13 @@ LAYOUT = os.environ.get("BENCH_LAYOUT", "NHWC")
 # it already meant chunks at r4, each chunk = PIPELINE_CHUNK steps.
 PIPELINE_CHUNKS = int(os.environ.get(
     "BENCH_PIPELINE_CHUNKS", os.environ.get("BENCH_PIPELINE_STEPS", 6)))
+# datapipe stage sizing: capacity bounds staged chunks resident on device
+# (double-buffering needs >=2; 4 keeps the transfer threads fed), and
+# TRANSFER_THREADS device_put whole chunks concurrently — independent
+# tunnel streams aggregate where one stream's bandwidth collapses.
+FEED_CAPACITY = int(os.environ.get("BENCH_FEED_CAPACITY", 4))
+TRANSFER_THREADS = int(os.environ.get("BENCH_TRANSFER_THREADS", 4))
+DECODE_WORKERS = int(os.environ.get("BENCH_DECODE_WORKERS", 2))
 
 
 def _build_train_program(fluid):
@@ -155,21 +166,28 @@ def _img_shape():
     return (224, 224, 3) if LAYOUT == "NHWC" else (3, 224, 224)
 
 
-def _record_reader(path):
-    """RecordIO -> decoded uint8 batches (the real input path's reader)."""
-    from paddle_tpu import recordio
-
+def _decode_record(rec):
+    """One RecordIO record -> one decoded pre-batched feed dict (runs on
+    the datapipe's ParallelMap workers)."""
     img_bytes = BATCH * 3 * 224 * 224
+    img = np.frombuffer(rec[:img_bytes], np.uint8).reshape(
+        (BATCH,) + _img_shape())
+    lbl = np.frombuffer(rec[img_bytes:], np.int64).reshape(
+        BATCH, 1).astype(np.int32)
+    return {"data_u8": img, "label": lbl}
 
-    def batches():
-        for rec in recordio.Scanner(path):
-            img = np.frombuffer(rec[:img_bytes], np.uint8).reshape(
-                (BATCH,) + _img_shape())
-            lbl = np.frombuffer(rec[img_bytes:], np.int64).reshape(
-                BATCH, 1).astype(np.int32)
-            yield {"data_u8": img, "label": lbl}
 
-    return batches
+def _build_pipe(fluid, path, K, stage_fn=None):
+    """The bench input pipe: sharded RecordIO source -> parallel decode ->
+    async chunked device staging. batch_read=2 keeps the read-ahead small
+    (each pre-batched record is ~19 MB)."""
+    return (fluid.datapipe.DataPipe
+            .from_recordio(path, batch_read=2)
+            .map(_decode_record, num_workers=DECODE_WORKERS)
+            .prefetch_to_device(place=fluid.TPUPlace(0), chunk=K,
+                                capacity=FEED_CAPACITY,
+                                transfer_threads=TRANSFER_THREADS,
+                                stage_fn=stage_fn))
 
 
 def _write_records(path, total):
@@ -215,9 +233,10 @@ def _run_pipeline(fluid, feeder, warm_chunks, timed_chunks, K):
 
 
 def measure_pipeline(fluid):
-    """REAL path: RecordIO -> decode thread -> DeviceChunkFeeder
-    (device_put per chunk) -> iters=K scan; plus a link-bandwidth probe."""
-    from paddle_tpu.reader import decorator
+    """REAL path: sharded RecordIO source -> ParallelMap decode ->
+    AsyncDeviceFeeder (TRANSFER_THREADS concurrent chunk device_puts) ->
+    iters=K scan; plus a link-bandwidth probe. Returns the achieved img/s
+    and the pipe's per-stage stats snapshot."""
     import jax
 
     K = PIPELINE_CHUNK
@@ -226,10 +245,9 @@ def measure_pipeline(fluid):
     path = "/tmp/bench_pipeline.recordio"
     total = (warm_chunks + timed_chunks) * K
     _write_records(path, total)
-    reader = decorator.buffered(_record_reader(path), 2)
 
-    # measure the tunnel's host->device bandwidth NOW (it is shared and
-    # varies ~50x between runs): one chunk-sized put, scalar-fenced
+    # measure the tunnel's SINGLE-STREAM host->device bandwidth NOW (it is
+    # shared and varies ~50x between runs): one chunk-sized put, fenced
     probe = np.zeros((K, BATCH) + _img_shape(), np.uint8)
     t = time.time()
     staged_probe = jax.device_put(probe)
@@ -237,20 +255,18 @@ def measure_pipeline(fluid):
     link_mbps = probe.nbytes / 1e6 / (time.time() - t)
     del staged_probe, probe
 
-    feeder = fluid.DeviceChunkFeeder(
-        reader, chunk=K, place=fluid.TPUPlace(0), capacity=2)
-    img_s = _run_pipeline(fluid, feeder, warm_chunks, timed_chunks, K)
+    pipe = _build_pipe(fluid, path, K)
+    img_s = _run_pipeline(fluid, pipe, warm_chunks, timed_chunks, K)
     img_mb = 3 * 224 * 224 / 1e6  # uint8 bytes per image on the wire
-    return img_s, link_mbps, link_mbps / img_mb
+    return img_s, link_mbps, link_mbps / img_mb, pipe.stats()
 
 
 def measure_pipeline_hostpath(fluid):
-    """Transport-independent path: identical reader -> decode -> stack ->
+    """Transport-independent path: identical source -> decode -> stack ->
     feeder -> iters=K machinery, but the staging step returns pre-staged
-    device chunks (DeviceChunkFeeder stage_fn) instead of pushing fresh
+    device chunks (AsyncDeviceFeeder stage_fn) instead of pushing fresh
     bytes through the shared tunnel. Decode + stacking still run at full
-    cost on the prefetch thread; only the link is off the critical path."""
-    from paddle_tpu.reader import decorator
+    cost on the datapipe workers; only the link is off the critical path."""
     import jax
 
     K = PIPELINE_CHUNK
@@ -259,7 +275,6 @@ def measure_pipeline_hostpath(fluid):
     path = "/tmp/bench_pipeline_host.recordio"
     total = (warm_chunks + timed_chunks) * K
     _write_records(path, total)
-    reader = decorator.buffered(_record_reader(path), 2)
 
     rs = np.random.RandomState(7)
     n_resident = 2
@@ -279,10 +294,8 @@ def measure_pipeline_hostpath(fluid):
         assert stacked["data_u8"].shape == (K, BATCH) + _img_shape()
         return prestaged[idx % n_resident]
 
-    feeder = fluid.DeviceChunkFeeder(
-        reader, chunk=K, place=fluid.TPUPlace(0), capacity=2,
-        stage_fn=stage_fn)
-    return _run_pipeline(fluid, feeder, warm_chunks, timed_chunks, K)
+    pipe = _build_pipe(fluid, path, K, stage_fn=stage_fn)
+    return _run_pipeline(fluid, pipe, warm_chunks, timed_chunks, K)
 
 
 def main():
@@ -316,11 +329,23 @@ def main():
             result["pipeline_hostpath_error"] = f"{type(e).__name__}: {e}"
     for attempt in range(2):
         try:
-            pipe_s, link_mbps, link_bound = measure_pipeline(fluid)
+            pipe_s, link_mbps, link_bound, stats = measure_pipeline(fluid)
             result["pipeline_images_per_sec"] = round(pipe_s, 2)
             result["pipeline_frac_of_device"] = round(pipe_s / img_s, 3)
             result["pipeline_link_MBps"] = round(link_mbps, 1)
             result["pipeline_link_bound_img_s"] = round(link_bound, 1)
+            result["pipeline_transfer_threads"] = TRANSFER_THREADS
+            # per-stage observability (datapipe.stats): where the pipeline
+            # time went — map.wait_in ~ raw read, map.busy ~ decode,
+            # stack.busy ~ chunk assembly, transfer.busy ~ device_put;
+            # transfer.wait_out ~ how long staged chunks sat ready (the
+            # device loop was the bottleneck, not the pipe)
+            result["pipeline_stage_fractions"] = stats.get("fractions", {})
+            result["pipeline_stage_busy_s"] = {
+                name: s["busy_s"] for name, s in stats.items()
+                if isinstance(s, dict) and "busy_s" in s}
+            tr = stats.get("transfer", {})
+            result["pipeline_transfer_MBps"] = tr.get("MB_per_sec", 0.0)
             result.pop("pipeline_error", None)
             break
         except Exception as e:  # headline metric must survive pipeline woes

@@ -95,6 +95,8 @@ from . import unique_name
 from . import reader
 from . import pipeline
 from .pipeline import DeviceChunkFeeder
+from . import datapipe
+from .datapipe import DataPipe, AsyncDeviceFeeder
 from . import dataset
 from . import parallel
 from .minibatch import batch
@@ -118,5 +120,6 @@ __all__ = [
     "Trainer", "Inferencer", "transpiler", "DistributeTranspiler",
     "InferenceTranspiler", "memory_optimize", "release_memory",
     "reader", "dataset", "batch", "unique_name", "parallel", "flags",
-    "concurrency",
+    "concurrency", "pipeline", "DeviceChunkFeeder", "datapipe", "DataPipe",
+    "AsyncDeviceFeeder",
 ]

@@ -109,7 +109,18 @@ def _append_backward_ops(block, target_names, no_grad, grad_map, checkpoint_segm
         # state) REPLACE instead of sum: the existing grad_map entry is the
         # grad w.r.t. the post-op value, which this op already consumed via
         # gout — summing it with the new pre-op grad would double-count.
-        inplace = set(op.output_arg_names())
+        # REPLACE is only sound when that consumption actually happened: the
+        # var must sit in a NON-stop-gradient output slot with a live gout
+        # entry. A var written only through a stop-gradient slot (e.g. a
+        # batch-norm-style MeanOut aliasing its Mean input) fed the op no
+        # cotangent, so its downstream grad must still SUM via @RENAME.
+        consumed = set()
+        for slot, names in op.outputs.items():
+            if slot in stop_slots:
+                continue
+            for n, g in zip(names, gout.get(slot, [])):
+                if g is not None:
+                    consumed.add(n)
         pre_seen = set()  # in-place vars already assigned a @PRE by THIS op
         pending_sums = []  # (out_name, [parts])
         for slot, outs in gin.items():
@@ -118,7 +129,7 @@ def _append_backward_ops(block, target_names, no_grad, grad_map, checkpoint_segm
                 if o is None:
                     v = names[i]
                     canonical = grad_var_name(v)
-                    if v in grad_map and v in inplace and v not in pre_seen:
+                    if v in grad_map and v in consumed and v not in pre_seen:
                         # first occurrence: the old entry (grad w.r.t. the
                         # post-op value) was consumed via gout — REPLACE
                         fresh = unique_name.generate(canonical + "@PRE")

@@ -1219,7 +1219,7 @@ def _host_noop(ctx):
 for _t in (
     "feed", "fetch", "print", "assert_op", "get_places", "delete_var",
     "save", "load", "save_combine", "load_combine",
-    "create_recordio_file_reader", "open_files",
+    "create_recordio_file_reader", "create_datapipe_reader", "open_files",
     "create_random_data_generator", "create_shuffle_reader",
     "create_batch_reader", "create_double_buffer_reader",
     "create_multi_pass_reader", "read",

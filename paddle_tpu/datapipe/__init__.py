@@ -1,0 +1,38 @@
+"""paddle_tpu.datapipe — parallel prefetching input pipeline.
+
+A tf.data/Grain-class subsystem that keeps the device fed: sharded
+seekable sources, threaded decode with bounded order-preserving queues,
+preallocated staging-buffer batching, and background host->device transfer
+with double buffering — each stage instrumented (queue depths, busy/wait
+ratios) through the profiler.
+
+    from paddle_tpu import datapipe
+    pipe = (datapipe.DataPipe.from_recordio(path, parse_fn=parse)
+            .map(decode, num_workers=4)
+            .batch(128)
+            .prefetch_to_device(chunk=10, capacity=4))
+    exe.run(program, feed=pipe, fetch_list=[loss])
+
+See docs/datapipe.md for the design and the stage-level semantics.
+"""
+
+from .batcher import Batcher
+from .feeder import AsyncDeviceFeeder
+from .parallel_map import ParallelMap
+from .pipeline import DataPipe
+from .source import (GeneratorSource, RecordIOSource, Source,
+                     default_shard_assignment)
+from .stats import PipeStats, StageStats
+
+__all__ = [
+    "DataPipe",
+    "Source",
+    "GeneratorSource",
+    "RecordIOSource",
+    "default_shard_assignment",
+    "ParallelMap",
+    "Batcher",
+    "AsyncDeviceFeeder",
+    "PipeStats",
+    "StageStats",
+]

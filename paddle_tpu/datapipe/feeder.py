@@ -1,0 +1,297 @@
+"""AsyncDeviceFeeder: background host->device staging with double buffering.
+
+Subsumes pipeline.DeviceChunkFeeder (now a thin shim over this): K batches
+are stacked into one [K, ...] array per feed name sized for
+Executor.run(feed=chunk, iters=K) — one jit dispatch per chunk, the only
+granularity that amortizes the ~600 ms tunnel dispatch latency.
+
+What's new over DeviceChunkFeeder:
+  * transfer_threads parallel device_put workers. On the tunneled TPU a
+    single transfer stream tops out far below the link's burst bandwidth
+    (BENCH r5: 56 MB/s achieved vs 1.6 GB/s bursts); T concurrent streams
+    each moving a WHOLE chunk overlap the stalls without adding device-side
+    concat dispatches. Emission order stays deterministic via a reorder
+    buffer keyed on chunk index.
+  * chunks are stacked into per-worker preallocated staging buffers (no
+    per-chunk allocation) and the copy happens under the pull lock, which
+    is the synchronous-copy boundary that makes an upstream zero-copy
+    Batcher ring safe.
+  * capacity tickets bound staged-chunks-in-flight (transferring + queued),
+    so a stalled consumer holds at most `capacity` chunk-sized device
+    buffers — backpressure all the way to the source.
+  * per-stage stats (stack/transfer busy, consumer starvation) and
+    profiler counter tracks.
+"""
+
+import threading
+
+import numpy as np
+
+from ..flags import define, get as get_flag
+
+__all__ = ["AsyncDeviceFeeder"]
+
+define("datapipe_transfer_threads", int, 0,
+       "Parallel host->device transfer threads for datapipe "
+       "AsyncDeviceFeeder (0 = auto: min(capacity, 2)).")
+
+
+class _End:
+    pass
+
+
+def _device_put_copies(dev):
+    """True when jax.device_put copies OUT of an aligned host buffer (any
+    real accelerator, where the put is a DMA across a link). XLA:CPU
+    instead zero-copy ALIASES 64-byte-aligned numpy arrays — staged chunks
+    would alias the feeder's reusable staging buffers and be silently
+    overwritten by the next refill, so buffer reuse must be disabled."""
+    import jax
+
+    raw = np.zeros(128, np.uint8)
+    off = (-raw.ctypes.data) % 64
+    probe = raw[off:off + 64].view(np.float32)
+    staged = jax.device_put(probe, dev)
+    jax.block_until_ready(staged)
+    probe[:] = 1.0
+    return not bool(np.asarray(staged)[0] == 1.0)
+
+
+class AsyncDeviceFeeder:
+    """Iterate device-resident feed dicts off background transfer thread(s).
+
+    source:           iterable of per-step feed dicts {name: ndarray}, or a
+                      reader creator (callable returning an iterator)
+    chunk:            K steps stacked per staged item ([K, ...] arrays for
+                      Executor.run(iters=K)); None = stage items as-is
+    place:            paddle_tpu Place to stage to (default jax device)
+    capacity:         staged chunks buffered ahead (>= 2: double buffer)
+    transfer_threads: parallel device_put workers (None = FLAGS
+                      datapipe_transfer_threads, 0 = auto)
+    stage_fn:         override for the staging step, stage_fn(idx, stacked)
+                      -> {name: device_array}; disables buffer reuse since
+                      the callee may keep host references
+    stack_stats /     optional StageStats receiving the stack-copy and
+    transfer_stats:   transfer/starvation counters
+
+    A partial tail chunk is dropped (odd [K', ...] shapes would force an
+    extra XLA compile), matching DeviceChunkFeeder.
+    """
+
+    def __init__(self, source, chunk=None, place=None, capacity=2,
+                 transfer_threads=None, stage_fn=None, stack_stats=None,
+                 transfer_stats=None):
+        if chunk is not None and int(chunk) < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if int(capacity) < 2:
+            raise ValueError(
+                f"capacity must be >= 2 (double buffer), got {capacity}")
+        self._source = source
+        self._chunk = None if chunk is None else int(chunk)
+        self._place = place
+        self._cap = int(capacity)
+        if transfer_threads is None:
+            transfer_threads = get_flag("datapipe_transfer_threads")
+        if int(transfer_threads) == 0:  # auto
+            transfer_threads = min(self._cap, 2)
+        if int(transfer_threads) < 1:
+            raise ValueError(
+                f"transfer_threads must be >= 1, got {transfer_threads}")
+        self._threads = min(int(transfer_threads), self._cap)
+        self._stage_fn = stage_fn
+        self._stack_stats = stack_stats
+        self._transfer_stats = transfer_stats
+        self._active = None  # stop flag of the live iteration (for close())
+
+    def _device(self):
+        if self._place is None:
+            return None
+        from ..core.places import jax_device_for
+
+        return jax_device_for(self._place)
+
+    def close(self):
+        """Stop the live iteration's workers (idempotent)."""
+        state = self._active
+        if state is not None:
+            state["stop"] = True
+            with state["cond"]:
+                state["cond"].notify_all()
+
+    def __iter__(self):
+        import time
+
+        import jax
+
+        src = self._source() if callable(self._source) \
+            else iter(self._source)
+        dev = self._device()
+        K = self._chunk
+        src_lock = threading.Lock()
+        tickets = threading.Semaphore(self._cap)
+        cond = threading.Condition()
+        done = {}  # chunk idx -> staged dict
+        state = {"next_in": 0, "next_out": 0, "eof_at": None,
+                 "error": None, "stop": False, "ended": 0, "cond": cond}
+        self._active = state
+        sst, tst = self._stack_stats, self._transfer_stats
+        puts_copy = self._stage_fn is not None or _device_put_copies(dev)
+        reuse_buffers = self._stage_fn is None and puts_copy
+
+        def fail(e):
+            with cond:
+                if state["error"] is None:
+                    state["error"] = e
+                cond.notify_all()
+
+        def pull_chunk(buf_holder):
+            """Under the source lock: pull K batches, copy them into this
+            worker's staging buffers. Returns (idx, stacked) or None at
+            EOF/stop. The copy-under-lock is the zero-copy ring boundary."""
+            with src_lock:
+                if state["eof_at"] is not None or state["error"] is not None \
+                        or state["stop"]:
+                    return None
+                try:
+                    if K is None:
+                        t0 = time.perf_counter()
+                        item = next(src, _End)
+                        if sst:
+                            sst.add_wait_in(time.perf_counter() - t0)
+                        if item is _End:
+                            state["eof_at"] = state["next_in"]
+                            with cond:
+                                cond.notify_all()
+                            return None
+                        # copy when device_put would alias the host array
+                        # (the upstream reader may reuse it between items)
+                        stacked = {n: np.asarray(a) if puts_copy
+                                   else np.array(a)
+                                   for n, a in item.items()}
+                        if sst:
+                            sst.add_item(nbytes=sum(
+                                a.nbytes for a in stacked.values()))
+                    else:
+                        got = 0
+                        buf = buf_holder[0]
+                        while got < K:
+                            t0 = time.perf_counter()
+                            item = next(src, _End)
+                            if sst:
+                                sst.add_wait_in(time.perf_counter() - t0)
+                            if item is _End:
+                                # partial tail: drop (DeviceChunkFeeder
+                                # semantics — no odd-shape recompile)
+                                state["eof_at"] = state["next_in"]
+                                with cond:
+                                    cond.notify_all()
+                                return None
+                            tb = time.perf_counter()
+                            if buf is None:
+                                buf = buf_holder[0] = {
+                                    n: np.empty(
+                                        (K,) + np.asarray(a).shape,
+                                        np.asarray(a).dtype)
+                                    for n, a in item.items()
+                                    if not n.startswith("__")}
+                            for n, b in buf.items():
+                                b[got] = item[n]
+                            got += 1
+                            if sst:
+                                sst.add_item(
+                                    busy_s=time.perf_counter() - tb,
+                                    nbytes=sum(np.asarray(item[n]).nbytes
+                                               for n in buf))
+                        if reuse_buffers:
+                            stacked = buf
+                        else:
+                            stacked = {n: b.copy() for n, b in buf.items()}
+                except BaseException as e:
+                    fail(e)
+                    return None
+                idx = state["next_in"]
+                state["next_in"] += 1
+                return idx, stacked
+
+        def work():
+            # buf_holder: this worker's private staging buffers — safe to
+            # refill once its previous transfer has completed (we block on
+            # the transfer below before looping)
+            buf_holder = [None]
+            try:
+                while not state["stop"]:
+                    while not tickets.acquire(timeout=0.2):
+                        if state["stop"]:
+                            return
+                    nxt = pull_chunk(buf_holder)
+                    if nxt is None:
+                        tickets.release()
+                        return
+                    idx, stacked = nxt
+                    try:
+                        t0 = time.perf_counter()
+                        if self._stage_fn is not None:
+                            staged = self._stage_fn(idx, stacked)
+                        else:
+                            staged = {n: jax.device_put(a, dev)
+                                      for n, a in stacked.items()}
+                            # wait for the copy out of our staging buffer
+                            # (also what makes transfer busy_s honest)
+                            jax.block_until_ready(staged)
+                        if tst:
+                            tst.add_item(
+                                busy_s=time.perf_counter() - t0,
+                                nbytes=sum(a.nbytes
+                                           for a in stacked.values()))
+                    except BaseException as e:
+                        fail(e)
+                        return
+                    with cond:
+                        done[idx] = staged
+                        cond.notify_all()
+            finally:
+                with cond:
+                    state["ended"] += 1
+                    cond.notify_all()
+
+        threads = [threading.Thread(target=work, daemon=True,
+                                    name=f"datapipe-feed-{i}")
+                   for i in range(self._threads)]
+        for t in threads:
+            t.start()
+
+        def next_staged():
+            t0 = time.perf_counter()
+            with cond:
+                while True:
+                    if state["error"] is not None:
+                        raise state["error"]
+                    if state["next_out"] in done:
+                        res = done.pop(state["next_out"])
+                        state["next_out"] += 1
+                        if tst:
+                            tst.add_wait_out(time.perf_counter() - t0)
+                            tst.sample_depth(len(done) + 1)
+                        return res
+                    if state["eof_at"] is not None and \
+                            state["next_out"] >= state["eof_at"]:
+                        return _End
+                    if state["ended"] == self._threads and not done:
+                        if state["error"] is not None:
+                            raise state["error"]
+                        return _End
+                    cond.wait(0.2)
+
+        try:
+            while True:
+                res = next_staged()
+                if res is _End:
+                    return
+                tickets.release()
+                yield res
+        finally:
+            state["stop"] = True
+            with cond:
+                cond.notify_all()
+            if self._active is state:
+                self._active = None

@@ -1,0 +1,162 @@
+"""ParallelMap: N worker threads over a bounded, order-preserving queue.
+
+Reference contrast: reader/decorator.py xmap_readers parallelizes the map
+but its ordered mode spin-waits (time.sleep polling) and its in-flight set
+is unbounded when one item is slow. This stage bounds total in-flight items
+with a ticket semaphore (backpressure all the way to the source) and
+re-emits results in input order through a condition-guarded reorder buffer.
+
+Threads, not processes: the heavy decode kernels this stage runs (numpy
+frombuffer/reshape/astype, zlib, PIL) release the GIL, which is the same
+reasoning the reference's threaded double-buffer reader relies on.
+"""
+
+import threading
+
+__all__ = ["ParallelMap"]
+
+
+class _End:
+    pass
+
+
+class ParallelMap:
+    """Iterate `fn(item)` over `source` with num_workers threads.
+
+    buffer_size bounds TOTAL in-flight items (being mapped + mapped but not
+    yet consumed): a slow consumer therefore stops the upstream source after
+    at most buffer_size items — bounded memory by construction.
+    order=True re-emits in input order (deterministic pipelines);
+    order=False emits as completed (lower latency under skewed item cost).
+    """
+
+    def __init__(self, source, fn, num_workers=2, buffer_size=None,
+                 order=True, stats=None):
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        self._source = source
+        self._fn = fn
+        self._workers = int(num_workers)
+        self._buf = int(buffer_size if buffer_size is not None
+                        else 2 * num_workers)
+        if self._buf < num_workers:
+            raise ValueError(
+                f"buffer_size {self._buf} < num_workers {num_workers} "
+                f"would idle workers permanently")
+        self._order = order
+        self._stats = stats
+
+    def __iter__(self):
+        import time
+
+        src = iter(self._source)
+        src_lock = threading.Lock()
+        tickets = threading.Semaphore(self._buf)
+        cond = threading.Condition()
+        done = {}          # idx -> result (order mode)
+        ready = []         # results (unordered mode)
+        state = {"next_in": 0, "next_out": 0, "eof_at": None,
+                 "error": None, "stop": False, "ended": 0}
+        st = self._stats
+
+        def pull():
+            """One (idx, item) under the source lock; None at EOF."""
+            with src_lock:
+                if state["eof_at"] is not None or state["error"] is not None:
+                    return None
+                try:
+                    t0 = time.perf_counter()
+                    item = next(src, _End)
+                    if st:
+                        st.add_wait_in(time.perf_counter() - t0)
+                except BaseException as e:
+                    with cond:
+                        state["error"] = e
+                        cond.notify_all()
+                    return None
+                if item is _End:
+                    state["eof_at"] = state["next_in"]
+                    with cond:
+                        cond.notify_all()
+                    return None
+                idx = state["next_in"]
+                state["next_in"] += 1
+                return idx, item
+
+        def work():
+            try:
+                while not state["stop"]:
+                    # ticket BEFORE pulling: bounds in-flight including the
+                    # item this worker is about to hold
+                    while not tickets.acquire(timeout=0.2):
+                        if state["stop"]:
+                            return
+                    nxt = pull()
+                    if nxt is None:
+                        tickets.release()
+                        return
+                    idx, item = nxt
+                    try:
+                        t0 = time.perf_counter()
+                        res = self._fn(item)
+                        if st:
+                            st.add_item(busy_s=time.perf_counter() - t0)
+                    except BaseException as e:
+                        with cond:
+                            if state["error"] is None:
+                                state["error"] = e
+                            cond.notify_all()
+                        return
+                    with cond:
+                        if self._order:
+                            done[idx] = res
+                        else:
+                            ready.append(res)
+                        cond.notify_all()
+            finally:
+                with cond:
+                    state["ended"] += 1
+                    cond.notify_all()
+
+        threads = [threading.Thread(target=work, daemon=True,
+                                    name=f"datapipe-map-{i}")
+                   for i in range(self._workers)]
+        for t in threads:
+            t.start()
+
+        def next_ready():
+            """Block until the next emittable result / EOF / error."""
+            with cond:
+                while True:
+                    if state["error"] is not None:
+                        raise state["error"]
+                    if self._order and state["next_out"] in done:
+                        res = done.pop(state["next_out"])
+                        state["next_out"] += 1
+                        return res
+                    if not self._order and ready:
+                        state["next_out"] += 1
+                        return ready.pop(0)
+                    if state["eof_at"] is not None and \
+                            state["next_out"] >= state["eof_at"]:
+                        return _End
+                    if state["ended"] == self._workers and not done \
+                            and not ready:
+                        # workers gone without EOF mark: error already set
+                        # or consumer raced a stop; re-check then bail
+                        if state["error"] is not None:
+                            raise state["error"]
+                        return _End
+                    cond.wait(0.2)
+
+        try:
+            while True:
+                res = next_ready()
+                if res is _End:
+                    return
+                tickets.release()  # consumed: let a worker pull one more
+                yield res
+        finally:
+            state["stop"] = True
+            with cond:
+                cond.notify_all()

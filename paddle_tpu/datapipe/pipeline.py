@@ -1,0 +1,209 @@
+"""DataPipe: composable, observable input pipeline.
+
+Wiring model (tf.data / Grain style, SURVEY §1 "Data pipeline"):
+
+    pipe = (datapipe.DataPipe.from_recordio("train-*.recordio",
+                                            parse_fn=parse)
+            .map(decode, num_workers=4)
+            .batch(128)
+            .prefetch_to_device(place=fluid.TPUPlace(0), chunk=10,
+                                capacity=4, transfer_threads=4))
+    for staged in pipe:                      # device-resident [K,...] dicts
+        exe.run(program, feed=staged, iters=10, ...)
+
+or hand the pipe straight to the executor, which pulls chunks itself:
+
+    exe.run(program, feed=pipe, fetch_list=[loss])   # iters=pipe.feed_iters
+
+Each stage runs concurrently with the others (worker threads + bounded
+queues with backpressure), and every stage records busy/wait/queue-depth
+counters surfaced by .stats() and the profiler timeline.
+
+Zero-copy handoff: when .batch() is immediately followed by
+.prefetch_to_device(chunk=K), the Batcher hands its ring staging buffers
+out directly (no per-batch copy) — safe because the feeder copies each
+batch into its chunk buffer under the pull lock before the next batch is
+pulled, which is the ring-reuse boundary batcher.py documents.
+"""
+
+from .batcher import Batcher
+from .parallel_map import ParallelMap
+from .source import GeneratorSource, RecordIOSource, Source
+from .stats import PipeStats
+
+__all__ = ["DataPipe"]
+
+
+def _named_sample_adapter(reader, feed_names):
+    """Legacy fluid readers yield positional tuples; wrap into the dict
+    samples the datapipe stages speak."""
+
+    def adapted():
+        it = reader() if callable(reader) else iter(reader)
+        for sample in it:
+            if isinstance(sample, dict):
+                yield sample
+                continue
+            if len(sample) != len(feed_names):
+                raise ValueError(
+                    f"reader sample has {len(sample)} slots, feed_names "
+                    f"names {len(feed_names)}: {feed_names}")
+            yield dict(zip(feed_names, sample))
+
+    return adapted
+
+
+class DataPipe:
+    """Immutable-ish builder: every transform returns a new DataPipe; the
+    stage chain (threads, queues, buffers) is only built on iteration."""
+
+    def __init__(self, source, _ops=None, _stats=None):
+        if not isinstance(source, Source):
+            source = GeneratorSource(source)
+        self._source = source
+        self._ops = list(_ops or [])
+        self._stats = _stats if _stats is not None else PipeStats()
+        self._stage_memo = {}  # op index -> StageStats (stable across iters)
+        self._it = None        # persistent iterator for next_feed()
+        self._layers = []      # built generators, innermost first
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def from_reader(cls, reader, feed_names=None):
+        """Wrap a legacy reader creator (callable yielding samples). With
+        feed_names, positional tuple samples become {name: value} dicts."""
+        if feed_names is not None:
+            reader = _named_sample_adapter(reader, list(feed_names))
+        return cls(GeneratorSource(reader))
+
+    @classmethod
+    def from_recordio(cls, paths, parse_fn=None, pass_num=1,
+                      num_shards=None, shard_index=None, batch_read=64):
+        return cls(RecordIOSource(paths, parse_fn=parse_fn,
+                                  pass_num=pass_num, num_shards=num_shards,
+                                  shard_index=shard_index,
+                                  batch_read=batch_read))
+
+    def _derive(self, op):
+        p = DataPipe(self._source, self._ops + [op], self._stats)
+        p._stage_memo = self._stage_memo
+        return p
+
+    def shard(self, num_shards, index):
+        """Restrict the SOURCE to one disjoint shard (record i belongs to
+        shard i % num_shards). Defaults come from the process topology at
+        source construction; call this to override explicitly."""
+        p = DataPipe(self._source.shard(num_shards, index), self._ops,
+                     self._stats)
+        p._stage_memo = self._stage_memo
+        return p
+
+    def map(self, fn, num_workers=2, buffer_size=None, order=True):
+        """Apply fn to every sample on num_workers threads (bounded,
+        order-preserving unless order=False)."""
+        return self._derive(("map", dict(fn=fn, num_workers=num_workers,
+                                         buffer_size=buffer_size,
+                                         order=order)))
+
+    def batch(self, batch_size, drop_remainder=True, pad_to_batch=False,
+              ring=2):
+        """Pack samples into preallocated [batch_size, ...] staging
+        buffers; see Batcher for the drop/pad tail modes."""
+        return self._derive(("batch", dict(batch_size=batch_size,
+                                           drop_remainder=drop_remainder,
+                                           pad_to_batch=pad_to_batch,
+                                           ring=ring)))
+
+    def prefetch_to_device(self, place=None, chunk=None, capacity=2,
+                           transfer_threads=None, stage_fn=None):
+        """Terminal stage: background host->device staging (see
+        AsyncDeviceFeeder). chunk=K stacks K batches per staged item for
+        Executor.run(iters=K); Executor reads K off .feed_iters."""
+        return self._derive(("device", dict(place=place, chunk=chunk,
+                                            capacity=capacity,
+                                            transfer_threads=transfer_threads,
+                                            stage_fn=stage_fn)))
+
+    # -- execution -------------------------------------------------------
+    @property
+    def feed_iters(self):
+        """K of the prefetch_to_device(chunk=K) stage, else None. The
+        executor uses this as its default iters= when fed a DataPipe."""
+        for kind, kw in self._ops:
+            if kind == "device" and kw["chunk"] is not None:
+                return kw["chunk"]
+        return None
+
+    def _stage(self, i, name):
+        if (i, name) not in self._stage_memo:
+            self._stage_memo[(i, name)] = self._stats.stage(name)
+        return self._stage_memo[(i, name)]
+
+    def _build(self):
+        from .feeder import AsyncDeviceFeeder
+
+        layers = []
+        cur = self._source
+        for i, (kind, kw) in enumerate(self._ops):
+            if kind == "map":
+                cur = iter(ParallelMap(cur, stats=self._stage(i, "map"),
+                                       **kw))
+            elif kind == "batch":
+                nxt = self._ops[i + 1] if i + 1 < len(self._ops) else None
+                zero_copy = bool(nxt and nxt[0] == "device"
+                                 and nxt[1]["chunk"] is not None)
+                cur = iter(Batcher(cur, zero_copy=zero_copy,
+                                   stats=self._stage(i, "batch"), **kw))
+            elif kind == "device":
+                cur = iter(AsyncDeviceFeeder(
+                    cur, stack_stats=self._stage(i, "stack"),
+                    transfer_stats=self._stage(i, "transfer"), **kw))
+            else:  # pragma: no cover - builder invariant
+                raise AssertionError(f"unknown op {kind!r}")
+            layers.append(cur)
+        return cur, layers
+
+    def __iter__(self):
+        cur, layers = self._build()
+        self._layers = layers
+        if not layers:  # bare source
+            yield from cur
+            return
+        try:
+            yield from cur
+        finally:
+            self.close(_keep_it=True)
+
+    # -- executor-facing pull API ---------------------------------------
+    def next_feed(self):
+        """Next staged feed dict off the persistent iterator (started on
+        first call); raises StopIteration when the pipe is exhausted."""
+        if self._it is None:
+            self._it = iter(self)
+        return next(self._it)
+
+    def reset(self):
+        """Stop the persistent iterator so the next next_feed() restarts
+        the pipeline from the source (fresh pass)."""
+        self.close()
+        self._it = None
+
+    def close(self, _keep_it=False):
+        """Shut down every stage's worker threads (idempotent). Closing
+        only the outermost generator would strand inner stages' workers
+        blocked on their queues, so each built layer is closed explicitly,
+        outermost first."""
+        if not _keep_it and self._it is not None:
+            it, self._it = self._it, None
+            it.close()
+        for gen in reversed(self._layers):
+            try:
+                gen.close()
+            except Exception:
+                pass
+        self._layers = []
+
+    def stats(self):
+        """{stage: {items, bytes, busy_s, wait_in_s, wait_out_s, ...},
+        'fractions': {...}} — see datapipe.stats.PipeStats.snapshot."""
+        return self._stats.snapshot()

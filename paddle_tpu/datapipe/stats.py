@@ -1,0 +1,125 @@
+"""Per-stage observability for the datapipe subsystem.
+
+Every pipeline stage owns a StageStats: item/byte counts, busy time (doing
+the stage's own work), wait-in time (blocked on the upstream queue) and
+wait-out time (blocked pushing downstream — backpressure), plus sampled
+queue depths. PipeStats aggregates them in wiring order and renders the
+dict `DataPipe.stats()` returns (and bench.py prints).
+
+Profiler integration: stage work spans are emitted through
+profiler.record_event (so they land in the host lane of the merged
+chrome trace) and queue depths through profiler.record_counter.
+"""
+
+import threading
+import time
+
+__all__ = ["StageStats", "PipeStats"]
+
+
+class StageStats:
+    """Counters for one pipeline stage; all mutation is lock-protected
+    (stages touch their stats from worker threads)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self.items = 0
+        self.bytes = 0
+        self.busy_s = 0.0
+        self.wait_in_s = 0.0
+        self.wait_out_s = 0.0
+        self._depth_sum = 0
+        self._depth_n = 0
+        self._t_first = None
+        self._t_last = None
+
+    # -- recording -----------------------------------------------------
+    def add_item(self, busy_s=0.0, nbytes=0):
+        now = time.perf_counter()
+        with self._lock:
+            self.items += 1
+            self.bytes += int(nbytes)
+            self.busy_s += busy_s
+            if self._t_first is None:
+                self._t_first = now
+            self._t_last = now
+
+    def add_wait_in(self, dt):
+        with self._lock:
+            self.wait_in_s += dt
+
+    def add_wait_out(self, dt):
+        with self._lock:
+            self.wait_out_s += dt
+
+    def sample_depth(self, depth):
+        with self._lock:
+            self._depth_sum += int(depth)
+            self._depth_n += 1
+        from .. import profiler
+
+        profiler.record_counter(f"datapipe/{self.name}/qdepth", depth)
+
+    def span(self):
+        """Context manager timing one unit of stage work; also emits a
+        profiler host event so stages show up in the merged timeline."""
+        from .. import profiler
+
+        return profiler.record_event(f"datapipe/{self.name}")
+
+    # -- reporting -----------------------------------------------------
+    def snapshot(self):
+        with self._lock:
+            span = ((self._t_last - self._t_first)
+                    if self._t_first is not None and self.items > 1 else 0.0)
+            d = {
+                "items": self.items,
+                "bytes": self.bytes,
+                "busy_s": round(self.busy_s, 6),
+                "wait_in_s": round(self.wait_in_s, 6),
+                "wait_out_s": round(self.wait_out_s, 6),
+            }
+            if span > 0:
+                d["items_per_sec"] = round(self.items / span, 2)
+                if self.bytes:
+                    d["MB_per_sec"] = round(self.bytes / 1e6 / span, 2)
+            if self._depth_n:
+                d["queue_depth_avg"] = round(
+                    self._depth_sum / self._depth_n, 2)
+            return d
+
+
+class PipeStats:
+    """Ordered collection of StageStats for one DataPipe."""
+
+    def __init__(self):
+        self._stages = []  # wiring order
+        self._lock = threading.Lock()
+
+    def stage(self, name):
+        with self._lock:
+            # unique-ify repeated stage kinds (two map stages, ...)
+            names = {s.name for s in self._stages}
+            base, n = name, 1
+            while name in names:
+                n += 1
+                name = f"{base}_{n}"
+            s = StageStats(name)
+            self._stages.append(s)
+            return s
+
+    def snapshot(self):
+        """{stage_name: counters} in wiring order, plus 'fractions': each
+        stage's busy time as a fraction of the pipeline wall span and the
+        consumer-visible wait fraction (how starved the device loop was)."""
+        with self._lock:
+            stages = list(self._stages)
+        out = {s.name: s.snapshot() for s in stages}
+        total_busy = sum(out[s.name]["busy_s"] for s in stages)
+        if total_busy > 0:
+            out["fractions"] = {
+                s.name: round(out[s.name]["busy_s"] / total_busy, 4)
+                for s in stages
+            }
+        return out

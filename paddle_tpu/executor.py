@@ -82,6 +82,27 @@ def _apply_debug_nans():
         _debug_nans_applied[0] = want
 
 
+def _memoize_packed(memo, key, P, views):
+    """Cache a PackPlan group's (packed buffer, unpacked views) for reuse
+    on the next run WITHOUT pinning device memory: the views are held as
+    weak refs (the scope owns the strong ones), and a finalizer evicts the
+    entry when any view dies — so a dropped/retired scope releases the
+    packed buffer instead of it riding in the compile cache forever. The
+    identity guard keeps a dying PREVIOUS generation's finalizer from
+    evicting the entry the current run just stored."""
+    import weakref
+
+    entry = None
+
+    def _evict(_ref):
+        if memo.get(key) is entry:
+            memo.pop(key, None)
+
+    refs = [weakref.ref(v, _evict) for v in views]
+    entry = (P, refs)
+    memo[key] = entry
+
+
 def _program_has_host_ops(program):
     for block in program.blocks:
         for op in block.ops:
@@ -203,13 +224,22 @@ class Executor:
         loop). For iters > 1, `feed` is either a list of K per-step feed
         dicts (stacked and transferred in one device_put) or a single dict
         whose arrays already carry a leading [K] axis (may be
-        device-resident, e.g. from pipeline.DeviceChunkFeeder). Fetches come
+        device-resident, e.g. from datapipe.AsyncDeviceFeeder). Fetches come
         back stacked with a leading [K] axis.
+
+        `feed` may also be a datapipe.DataPipe (anything with next_feed()):
+        the executor pulls the next prefetched chunk itself and defaults
+        iters to the pipe's chunk size (feed_iters). The pipe's
+        StopIteration propagates when it is exhausted.
         """
         if program is None:
             program = default_main_program()
         if scope is None:
             scope = global_scope()
+        if hasattr(feed, "next_feed"):  # datapipe.DataPipe (duck-typed)
+            if iters is None:
+                iters = getattr(feed, "feed_iters", None)
+            feed = feed.next_feed()
         if isinstance(feed, (list, tuple)) and iters is None:
             iters = len(feed)  # length consistency checked in the helper
         feed = feed if feed is not None else {}
@@ -406,14 +436,22 @@ class Executor:
         if plan is not None:
             # reuse the previous call's packed buffers when the scope still
             # holds exactly the views we wrote back (the steady state) —
-            # repacking costs one eager concat per group otherwise
+            # repacking costs one eager concat per group otherwise. The
+            # views are memoized as WEAK refs (the scope owns them): a dead
+            # ref or identity mismatch means the scope moved on, and the
+            # stale entry is evicted so its packed buffer's HBM is freed
+            # instead of riding in the compile cache forever.
             packed_in = {}
             for g in plan.groups:
                 prev = memo.get(g["key"])
-                if prev is not None and all(
-                        scope.find_var(n) is v
-                        for (n, _, _, _), v in zip(g["entries"], prev[1])):
-                    packed_in[g["key"]] = prev[0]
+                if prev is not None:
+                    views_prev = [r() for r in prev[1]]
+                    if all(v is not None and scope.find_var(n) is v
+                           for (n, _, _, _), v in zip(g["entries"],
+                                                      views_prev)):
+                        packed_in[g["key"]] = prev[0]
+                    else:
+                        memo.pop(g["key"], None)
             repack = {n: v for n, v in mut_state.items()
                       if n in plan.packed_names}
             mut_state = {n: v for n, v in mut_state.items()
@@ -441,7 +479,7 @@ class Executor:
                 views = unpackers[g["key"]](P)
                 for (n, _, _, _), v in zip(g["entries"], views):
                     plain[n] = v
-                memo[g["key"]] = (P, views)
+                _memoize_packed(memo, g["key"], P, views)
             new_mut = plain
         for n, v in new_mut.items():
             scope.set_var(n, v)

@@ -7,8 +7,9 @@ from .. import unique_name
 
 __all__ = [
     "data", "BlockGuardServ", "ListenAndServ", "Send", "Recv",
-    "open_recordio_file", "open_files", "read_file", "shuffle", "batch",
-    "double_buffer", "multi_pass", "random_data_generator",
+    "open_recordio_file", "open_files", "open_datapipe", "read_file",
+    "shuffle", "batch", "double_buffer", "multi_pass",
+    "random_data_generator",
 ]
 
 
@@ -188,6 +189,43 @@ def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1, buffer_size=
             "lod_levels": list(lod_levels),
             "thread_num": thread_num,
             "pass_num": pass_num,
+        },
+    )
+    return var
+
+
+def open_datapipe(pipe, slot_names, shapes, dtypes, lod_levels=None):
+    """Expose a datapipe.DataPipe as a reader VARIABLE, so read_file and
+    the rest of the reader-op surface consume the prefetching pipeline.
+    Each pipeline item (a {name: array} dict — usually pipe.batch() output)
+    becomes one read, slots ordered by slot_names. The live pipe cannot be
+    serialized into op attrs, so it is parked in a process-local registry
+    and the creation op carries an integer token (programs using this op
+    are not portable across processes)."""
+    if lod_levels is None:
+        lod_levels = [0] * len(slot_names)
+    if not (len(slot_names) == len(shapes) == len(dtypes) == len(lod_levels)):
+        raise ValueError(
+            f"slot_names/shapes/dtypes/lod_levels lengths differ: "
+            f"{len(slot_names)}/{len(shapes)}/{len(dtypes)}/"
+            f"{len(lod_levels)}")
+    from ..ops.reader_ops import register_datapipe
+
+    helper = LayerHelper("open_datapipe")
+    name = unique_name.generate("datapipe_reader")
+    var = _create_reader_var(name, shapes, dtypes, lod_levels)
+    startup = default_startup_program()
+    startup.global_block().create_var(name=name, type=VarType.READER, persistable=True)
+    startup.global_block().append_op(
+        "create_datapipe_reader",
+        {},
+        {"Out": [name]},
+        {
+            "token": register_datapipe(pipe),
+            "slot_names": list(slot_names),
+            "shapes": [list(s) for s in shapes],
+            "dtypes": list(dtypes),
+            "lod_levels": list(lod_levels),
         },
     )
     return var

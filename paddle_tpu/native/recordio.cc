@@ -221,6 +221,87 @@ int rio_scanner_next(void* sp, char** buf, uint64_t* len) {
   return 1;
 }
 
+// Batch read: up to max_records records from the CURRENT chunk in one call
+// (one malloc + one ctypes crossing instead of per-record round-trips).
+// *buf receives the concatenated payloads, *lens the per-record lengths;
+// the caller frees both via rio_free. May return fewer than requested at a
+// chunk boundary; 0 at end of stream (or first corrupt chunk).
+int rio_scanner_next_batch(void* sp, int max_records, char** buf,
+                           uint64_t** lens) {
+  auto* s = static_cast<Scanner*>(sp);
+  if (max_records <= 0) return 0;
+  while (s->pos >= s->chunk.size()) {
+    if (!read_chunk(s)) return 0;
+  }
+  size_t n = s->chunk.size() - s->pos;
+  if (n > static_cast<size_t>(max_records)) n = max_records;
+  size_t total = 0;
+  for (size_t i = 0; i < n; ++i) total += s->chunk[s->pos + i].size();
+  *buf = static_cast<char*>(malloc(total ? total : 1));
+  *lens = static_cast<uint64_t*>(malloc(n * sizeof(uint64_t)));
+  if (!*buf || !*lens) {
+    free(*buf);
+    free(*lens);
+    return 0;
+  }
+  size_t off = 0;
+  for (size_t i = 0; i < n; ++i) {
+    const std::string& r = s->chunk[s->pos + i];
+    memcpy(*buf + off, r.data(), r.size());
+    (*lens)[i] = r.size();
+    off += r.size();
+  }
+  s->pos += n;
+  return static_cast<int>(n);
+}
+
+// Skip up to n records; whole chunks are fseek'd past WITHOUT reading or
+// decompressing their payload (the seekable-shard fast path: a 1-of-N
+// stride shard decodes only the chunks it owns records in). Returns the
+// number actually skipped (< n only at end of stream). Note: a chunk
+// skipped wholesale is not CRC-verified — corruption there surfaces when
+// some scanner actually reads it.
+uint64_t rio_scanner_skip(void* sp, uint64_t n) {
+  auto* s = static_cast<Scanner*>(sp);
+  uint64_t skipped = 0;
+  while (skipped < n) {
+    if (s->pos < s->chunk.size()) {
+      uint64_t avail = s->chunk.size() - s->pos;
+      uint64_t take = n - skipped < avail ? n - skipped : avail;
+      s->pos += take;
+      skipped += take;
+      continue;
+    }
+    // peek the next chunk header; if every record in it is skipped, seek
+    // past the stored payload undecoded
+    long hdr = ftell(s->f);
+    uint32_t magic = 0, cn = 0, codec = 0, crc = 0;
+    uint64_t raw_len = 0, stored_len = 0;
+    bool ok = fread(&magic, 4, 1, s->f) == 1 && magic == kMagic &&
+              fread(&cn, 4, 1, s->f) == 1 &&
+              fread(&codec, 4, 1, s->f) == 1 &&
+              fread(&raw_len, 8, 1, s->f) == 1 &&
+              fread(&stored_len, 8, 1, s->f) == 1 &&
+              fread(&crc, 4, 1, s->f) == 1 &&
+              raw_len <= kMaxChunkBytes && stored_len <= kMaxChunkBytes;
+    if (!ok) {
+      if (hdr >= 0) fseek(s->f, hdr, SEEK_SET);
+      return skipped;
+    }
+    if (cn <= n - skipped) {
+      if (fseek(s->f, static_cast<long>(stored_len), SEEK_CUR) != 0) {
+        return skipped;
+      }
+      skipped += cn;
+      continue;
+    }
+    // partially-skipped chunk: rewind and decode it normally
+    if (fseek(s->f, hdr, SEEK_SET) != 0) return skipped;
+    if (!read_chunk(s)) return skipped;
+  }
+  return skipped;
+}
+
 void rio_scanner_reset(void* sp) {
   auto* s = static_cast<Scanner*>(sp);
   fseek(s->f, 0, SEEK_SET);

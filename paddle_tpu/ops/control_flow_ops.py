@@ -258,8 +258,29 @@ def while_grad_op(ctx, ins, attrs):
             return new, None
 
         final, _ = lax.scan(body, carry0, None, length=trips)
+        if debug_check and cond_name in final:
+            # the masked replay is only exact if the forward loop actually
+            # terminated within the declared bound; a still-true condition
+            # after `trips` steps means the trajectory was truncated and
+            # the gradients below would be silently wrong
+            def _assert_terminated(c):
+                import numpy as np
+
+                if bool(np.any(np.asarray(c))):
+                    raise FloatingPointError(
+                        f"while_grad: condition {cond_name!r} is still "
+                        f"true after max_trip_count={trips} replay steps "
+                        f"— the forward loop ran longer than its declared "
+                        f"bound, so the replayed gradient trajectory is "
+                        f"truncated. Raise max_trip_count on the While "
+                        f"layer.")
+
+            jax.debug.callback(_assert_terminated, final[cond_name])
         return {n: final[n] for n in diff_carry}
 
+    from .. import flags as _flags
+
+    debug_check = _flags.get("check_nan_inf") or _flags.get("debug_nans")
     finals, vjp_fn = jax.vjp(fwd, diff_init, diff_closure)
     g_init, g_closure = vjp_fn(_cotangents(finals, gouts))
     return {"X@GRAD": _assemble_grads(x_names, g_init, g_closure)}
@@ -304,6 +325,11 @@ def conditional_block_op(ctx, ins, attrs):
     out_names = list(op.output("Out") or [])
     inits = {n: env.get(n) for n in out_names}
     entry = {n: env.get(n) for n in op.input("Input")}
+    # the predicate too must replay from entry-time values: snapshot X
+    # BEFORE the block's writes land in env (a sub-block may overwrite its
+    # own predicate var, and the grad replay must still take the branch the
+    # forward took)
+    cond_entry = {n: env.get(n) for n in op.input("X")}
     result = lax.cond(pred, true_fn, false_fn, 0)
     env.update(dict(zip(written, result)))
     ret = {}
@@ -312,8 +338,7 @@ def conditional_block_op(ctx, ins, attrs):
     if op.output("InputSnapshots"):
         ret["InputSnapshots"] = [entry.get(n) for n in op.input("Input")]
     if op.output("CondSnapshots"):
-        # the predicate too must replay from entry-time values
-        ret["CondSnapshots"] = [env.get(n) for n in op.input("X")]
+        ret["CondSnapshots"] = [cond_entry.get(n) for n in op.input("X")]
     return ret
 
 

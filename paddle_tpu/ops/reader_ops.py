@@ -233,6 +233,48 @@ class DoubleBufferReader(ReaderBase):
         self._start()
 
 
+class DataPipeReader(ReaderBase):
+    """Bridge a datapipe.DataPipe into the reader-variable world: each
+    read_next() pops one pipeline item (a {name: array} dict, typically a
+    batch) and presents it as positional (array, lod) slots in slot_names
+    order — so layers.read_file works unchanged on top of the prefetching
+    pipeline."""
+
+    def __init__(self, pipe, slot_names):
+        self._pipe = pipe
+        self._slots = list(slot_names)
+        self._it = iter(pipe)
+
+    def read_next(self):
+        item = next(self._it, None)
+        if item is None:
+            return None
+        try:
+            return [(np.asarray(item[n]), None) for n in self._slots]
+        except KeyError as e:
+            raise KeyError(
+                f"datapipe item is missing slot {e.args[0]!r}; it has "
+                f"{sorted(item)}") from None
+
+    def reset(self):
+        close = getattr(self._it, "close", None)
+        if close:
+            close()
+        self._it = iter(self._pipe)
+
+
+# Live DataPipe objects cannot ride in op attrs (attrs must serialize);
+# layers.io.open_datapipe parks the pipe here and the creation op carries
+# only the integer token.
+_datapipe_registry = {}
+
+
+def register_datapipe(pipe):
+    token = len(_datapipe_registry) + 1
+    _datapipe_registry[token] = pipe
+    return token
+
+
 class MultiPassReader(ReaderBase):
     def __init__(self, underlying, pass_num):
         self._u = underlying
@@ -329,6 +371,19 @@ def create_double_buffer_reader_op(ctx, ins, attrs):
 
             dev = jax_device_for(ctx.place)
         return DoubleBufferReader(_underlying(ctx, ins), device=dev)
+
+    return _store_reader(ctx, make)
+
+
+@register_op("create_datapipe_reader", no_trace=True, lod_aware=True)
+def create_datapipe_reader_op(ctx, ins, attrs):
+    def make():
+        pipe = _datapipe_registry.get(attrs["token"])
+        if pipe is None:
+            raise ValueError(
+                f"datapipe token {attrs['token']} not registered (the "
+                f"program outlived the process that built its pipe)")
+        return DataPipeReader(pipe, attrs["slot_names"])
 
     return _store_reader(ctx, make)
 

@@ -170,9 +170,16 @@ class ParallelExecutor:
         """One data-parallel step over the mesh — or, with `iters=K`, K
         steps inside ONE jit'd lax.scan dispatch (feeds carry a leading
         [K] axis, batch sharded over "dp" on axis 1; fetches come back
-        stacked [K, ...]). Same contract as Executor.run(iters=K)."""
+        stacked [K, ...]). Same contract as Executor.run(iters=K).
+
+        `feed` may be a datapipe.DataPipe: the next prefetched chunk is
+        pulled here and iters defaults to the pipe's chunk size."""
         _apply_debug_nans()
         feed = feed if feed is not None else feed_dict
+        if hasattr(feed, "next_feed"):  # datapipe.DataPipe (duck-typed)
+            if iters is None:
+                iters = getattr(feed, "feed_iters", None)
+            feed = feed.next_feed()
         if isinstance(feed, list) and iters is None:
             # per-device feed list (reference feed_parallel): concatenate
             merged = {}

@@ -1,29 +1,22 @@
-"""Device-staged input pipeline.
+"""Device-staged input pipeline — DEPRECATED shim.
 
-Reference parity: operators/reader/create_double_buffer_reader_op.cc:34-69 —
-a dedicated thread stages upcoming batches into DEVICE memory (the reference
-keeps a GPU tensor cache fed by per-buffer CUDADeviceContexts) so the compute
-stream never waits on host->device copies.
-
-TPU adaptation: per-step dispatch latency, not link bandwidth, dominates a
-naive feed loop on a tunneled chip (measured: ~25 ms for a 19 MB device_put
-vs ~600 ms per jit dispatch), so staging happens at CHUNK granularity — K
-consecutive batches are stacked into one [K, ...] array per feed name and
-device_put once, sized for Executor.run(feed=chunk, iters=K), which runs the
-K steps inside a single jit'd lax.scan dispatch. The prefetch thread stacks
-and transfers chunk k+1 while chunk k trains.
+DeviceChunkFeeder's machinery moved into paddle_tpu.datapipe (the
+subsystem version adds parallel transfer streams, preallocated staging
+buffers, per-stage stats and backpressure); this module keeps the original
+class as a thin wrapper over datapipe.AsyncDeviceFeeder so existing call
+sites keep working. New code should build a datapipe.DataPipe
+(.batch().prefetch_to_device(chunk=K)) or use AsyncDeviceFeeder directly.
 """
 
-import threading
-from queue import Queue
-
-import numpy as np
+import warnings
 
 __all__ = ["DeviceChunkFeeder"]
 
 
 class DeviceChunkFeeder:
-    """Iterate device-resident [K, ...] feed dicts off a prefetch thread.
+    """Deprecated: use datapipe.AsyncDeviceFeeder / DataPipe.
+
+    Iterate device-resident [K, ...] feed dicts off a prefetch thread.
 
     reader():      yields per-step feed dicts {name: ndarray}
     chunk:         K, the number of steps per dispatch (Executor iters=K)
@@ -32,92 +25,26 @@ class DeviceChunkFeeder:
     capacity:      staged chunks buffered ahead (2 = classic double buffer)
     stage_fn:      optional override for the host->device staging step,
                    called as stage_fn(chunk_index, {name: stacked_ndarray})
-                   -> {name: device_array}. Default: jax.device_put per
-                   array. Benchmarks use this to measure the pipeline
-                   machinery with transfers taken off the critical path.
+                   -> {name: device_array}
 
-    The tail is dropped if fewer than `chunk` batches remain (a partial
-    chunk would force a second XLA compile for the odd shape).
+    The tail is dropped if fewer than `chunk` batches remain. A single
+    transfer thread is kept (the historical behavior: stage_fn sees chunk
+    indices strictly in order); pass transfer_threads to
+    AsyncDeviceFeeder for parallel transfer streams.
     """
 
-    _END = object()
-
     def __init__(self, reader, chunk, place=None, capacity=2, stage_fn=None):
-        self._reader = reader
-        self._chunk = int(chunk)
-        self._place = place
-        self._cap = int(capacity)
-        self._stage_fn = stage_fn
-        if self._chunk < 1:
+        warnings.warn(
+            "pipeline.DeviceChunkFeeder is deprecated; use "
+            "datapipe.AsyncDeviceFeeder (or DataPipe.prefetch_to_device)",
+            DeprecationWarning, stacklevel=2)
+        from .datapipe import AsyncDeviceFeeder
+
+        if int(chunk) < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
-
-    def _device(self):
-        if self._place is None:
-            return None
-        from .core.places import jax_device_for
-
-        return jax_device_for(self._place)
+        self._feeder = AsyncDeviceFeeder(
+            reader, chunk=chunk, place=place, capacity=max(2, int(capacity)),
+            transfer_threads=1, stage_fn=stage_fn)
 
     def __iter__(self):
-        import jax
-
-        q = Queue(maxsize=self._cap)
-        stop = threading.Event()
-        dev = self._device()
-
-        def put(item):
-            # bounded wait so a consumer that stopped iterating (e.g. its
-            # train step raised) releases the worker instead of pinning
-            # `capacity` chunk-sized device buffers behind a blocked put
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.2)
-                    return True
-                except Exception:  # queue.Full
-                    continue
-            return False
-
-        def work():
-            try:
-                batches = []
-                chunk_idx = 0
-                for batch in self._reader():
-                    if stop.is_set():
-                        return
-                    batches.append(batch)
-                    if len(batches) < self._chunk:
-                        continue
-                    stacked = {
-                        n: np.stack([np.asarray(b[n]) for b in batches], 0)
-                        for n in batches[0]
-                    }
-                    if self._stage_fn is not None:
-                        staged = self._stage_fn(chunk_idx, stacked)
-                    else:
-                        staged = {n: jax.device_put(a, dev)
-                                  for n, a in stacked.items()}
-                    chunk_idx += 1
-                    if not put(staged):
-                        return
-                    batches = []
-                put(self._END)
-            except BaseException as e:  # surface reader errors to consumer
-                put(e)
-
-        t = threading.Thread(target=work, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is self._END:
-                    return
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            stop.set()
-            try:
-                while True:
-                    q.get_nowait()
-            except Exception:  # queue.Empty — drained
-                pass
+        return iter(self._feeder)

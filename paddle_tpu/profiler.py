@@ -13,9 +13,11 @@ from collections import defaultdict
 import jax
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
-           "stop_profiler", "record_event", "export_chrome_trace"]
+           "stop_profiler", "record_event", "record_counter",
+           "export_chrome_trace"]
 
 _host_events = []  # (name, start, end)
+_counter_events = []  # (name, t, value) — chrome-trace "C" counter samples
 _enabled = False
 _trace_dir = None
 _last_trace_dir = None  # survives stop_profiler so export can merge
@@ -43,9 +45,17 @@ def record_event(name):
             _host_events.append(ev)
 
 
+def record_counter(name, value):
+    """Sample a named counter (e.g. a datapipe queue depth); rendered as a
+    chrome-trace counter track ("ph": "C") in export_chrome_trace."""
+    if _enabled:
+        _counter_events.append((name, time.perf_counter(), float(value)))
+
+
 def reset_profiler():
     global _last_trace_dir, _trace_t0
     del _host_events[:]
+    del _counter_events[:]
     _last_trace_dir = None
     _trace_t0 = None
 
@@ -166,6 +176,14 @@ def export_chrome_trace(path):
             "pid": 0,
             "tid": "host",
             "cat": "host",
+        })
+    for name, t, value in _counter_events:
+        events.append({
+            "name": name,
+            "ph": "C",
+            "ts": (t - t0) * 1e6,
+            "pid": 0,
+            "args": {"value": value},
         })
     if _last_trace_dir:
         events.extend(_load_device_trace(_last_trace_dir))

@@ -7,11 +7,11 @@ compose readers. Used by both the dataset package and training loops
 
 from .decorator import (
     map_readers, buffered, compose, chain, shuffle, firstn, xmap_readers,
-    cache,
+    cache, to_datapipe,
 )
 from . import creator
 
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
-    "xmap_readers", "cache", "creator",
+    "xmap_readers", "cache", "creator", "to_datapipe",
 ]

@@ -12,8 +12,19 @@ from threading import Thread
 
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
-    "xmap_readers", "cache",
+    "xmap_readers", "cache", "to_datapipe",
 ]
+
+
+def to_datapipe(reader, feed_names):
+    """Adapt a legacy decorated reader (a creator yielding positional
+    tuples) into a datapipe.DataPipe whose samples are {name: value} dicts
+    keyed by feed_names — the migration bridge from the reader-decorator
+    stack to the prefetching pipeline (.batch()/.prefetch_to_device() are
+    then available on the result)."""
+    from ..datapipe import DataPipe
+
+    return DataPipe.from_reader(reader, feed_names=feed_names)
 
 
 def map_readers(func, *readers):

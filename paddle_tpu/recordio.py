@@ -41,6 +41,13 @@ def _load():
         lib.rio_scanner_next.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
             ctypes.POINTER(ctypes.c_uint64)]
+        lib.rio_scanner_next_batch.restype = ctypes.c_int
+        lib.rio_scanner_next_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64))]
+        lib.rio_scanner_skip.restype = ctypes.c_uint64
+        lib.rio_scanner_skip.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.rio_scanner_reset.argtypes = [ctypes.c_void_p]
         lib.rio_scanner_close.argtypes = [ctypes.c_void_p]
         lib.rio_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
@@ -93,6 +100,36 @@ class Scanner:
             data = ctypes.string_at(buf, ln.value)
             self._lib.rio_free(buf)
             yield data
+
+    def read_batch(self, n):
+        """Up to n records in ONE native call (one ctypes crossing + one
+        allocation, vs per-record round-trips through __iter__). May return
+        fewer than n at a chunk boundary; [] at end of stream."""
+        buf = ctypes.POINTER(ctypes.c_char)()
+        lens = ctypes.POINTER(ctypes.c_uint64)()
+        got = self._lib.rio_scanner_next_batch(
+            self._h, int(n), ctypes.byref(buf), ctypes.byref(lens))
+        if got <= 0:
+            return []
+        try:
+            base = ctypes.addressof(buf.contents)
+            out, off = [], 0
+            for i in range(got):
+                ln = lens[i]
+                out.append(ctypes.string_at(base + off, ln))
+                off += ln
+            return out
+        finally:
+            self._lib.rio_free(buf)
+            self._lib.rio_free(
+                ctypes.cast(lens, ctypes.POINTER(ctypes.c_char)))
+
+    def skip(self, n):
+        """Skip up to n records without copying them across the C boundary
+        (fully-skipped chunks are fseek'd past undecoded — the sharded-read
+        fast path). Returns the count actually skipped (< n only at end of
+        stream)."""
+        return int(self._lib.rio_scanner_skip(self._h, int(n)))
 
     def reset(self):
         self._lib.rio_scanner_reset(self._h)

@@ -193,8 +193,36 @@ class Trainer:
         return DataFeeder(feed_list=feed_var_list, place=self.place,
                           program=self.train_program)
 
+    def _train_by_datapipe(self, num_epochs, event_handler, pipe):
+        """Drive training straight off a datapipe.DataPipe: staged items
+        are already device-resident feed dicts (no DataFeeder), and a
+        chunked pipe (prefetch_to_device(chunk=K)) runs its K steps in one
+        dispatch per iteration (Executor.run iters=K)."""
+        exe = Executor(self.place)
+        iters = pipe.feed_iters
+        for epoch_id in range(num_epochs):
+            event_handler(BeginEpochEvent(epoch_id))
+            for step_id, staged in enumerate(pipe):
+                if self.__stop:
+                    pipe.close()
+                    return
+                begin_event = BeginStepEvent(epoch_id, step_id)
+                event_handler(begin_event)
+                fetch = (
+                    [v.name for v in self.train_func_outputs]
+                    if begin_event.fetch_metrics
+                    else []
+                )
+                metrics = exe.run(self.train_program, feed=staged,
+                                  fetch_list=fetch, iters=iters)
+                event_handler(EndStepEvent(epoch_id, step_id, metrics))
+            event_handler(EndEpochEvent(epoch_id))
+
     def _train_by_executor(self, num_epochs, event_handler, reader, feed_order):
         with self._prog_and_scope_guard():
+            if hasattr(reader, "next_feed"):  # datapipe.DataPipe
+                self._train_by_datapipe(num_epochs, event_handler, reader)
+                return
             feeder = self._get_or_make_feeder(feed_order)
             if self.parallel:
                 pe = ParallelExecutor(

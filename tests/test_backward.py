@@ -93,3 +93,56 @@ def test_inplace_multi_slot_grad_sums_within_op():
                   fetch_list=[g])
     np.testing.assert_allclose(np.asarray(gv), np.full((1, 4), 0.5),
                                rtol=1e-6)
+
+
+def test_stop_gradient_slot_alias_grad_sums():
+    """REPLACE (vs RENAME-sum) for an in-place var is only sound when this
+    op actually consumed the var's downstream grad through a
+    NON-stop-gradient output slot. An op whose stop-gradient side output
+    aliases its input (batch-norm MeanOut style) fed the op no cotangent
+    via that write, so the downstream grad must still SUM."""
+    from paddle_tpu.core import registry
+
+    if "alias_stats_t" not in registry._registry:
+        from paddle_tpu.ops.util import first, out
+
+        @registry.register_op("alias_stats_t")
+        def _alias_stats(ctx, ins, attrs):
+            v = first(ins, "X")
+            return out(Out=v * 3.0, StatOut=v)
+
+        registry.set_stop_gradient_outputs("alias_stats_t", ["StatOut"])
+
+        from paddle_tpu.core import shape_inference
+
+        @shape_inference.register_infer_shape("alias_stats_t")
+        def _alias_stats_shape(ctx):
+            ctx.set_output_dim("Out", ctx.input_dim("X"))
+            ctx.set_output_dim("StatOut", ctx.input_dim("X"))
+
+        @registry.register_grad_maker("alias_stats_t")
+        def _alias_stats_grad(op, gout, gin):
+            g = (gout.get("Out") or [None])[0]
+            return [dict(type="scale", inputs={"X": [g]},
+                         outputs={"Out": [gin["X"][0]]},
+                         attrs={"scale": 3.0})]
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        v = fluid.layers.scale(x, scale=2.0)
+        blk = main.current_block()
+        w = blk.create_var(name="w_alias", shape=[1, 4], dtype="float32")
+        # StatOut writes v's own name through the stop-gradient slot
+        blk.append_op("alias_stats_t", {"X": [v.name]},
+                      {"Out": [w.name], "StatOut": [v.name]}, {})
+        y = fluid.layers.scale(v, scale=5.0)
+        loss = fluid.layers.sums(
+            [fluid.layers.mean(y), fluid.layers.mean(w)])
+        g, = backward.calc_gradient(loss, [x])
+    gv, = _run(main, {"x": np.ones((1, 4), np.float32)}, [g])
+    # dloss/dx = d mean(5*2x)/dx + d mean(3*2x)/dx = 10/4 + 6/4; dropping
+    # the y path via a bogus REPLACE would leave only 6/4
+    np.testing.assert_allclose(np.asarray(gv), np.full((1, 4), 4.0),
+                               rtol=1e-6)

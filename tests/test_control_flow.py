@@ -647,3 +647,75 @@ def test_switch_grads_follow_active_case():
                           fetch_list=[g])
             np.testing.assert_allclose(
                 np.asarray(gv), np.full((1, 4), expect), rtol=1e-5)
+
+
+def test_while_grad_trip_count_debug_check():
+    """A forward loop that needs MORE trips than its declared
+    max_trip_count silently truncates the replayed grad trajectory; under
+    the debug flags (check_nan_inf / debug_nans) the replay must abort
+    loudly, naming max_trip_count, instead of returning wrong grads."""
+    from paddle_tpu import backward, flags
+
+    main, startup, x, loss = _while_sum_program(2)  # loop really runs 3x
+    with program_guard(main, startup):
+        g, = backward.calc_gradient(loss, [x])
+    xv = np.ones((1, 4), np.float32)
+
+    # non-debug path: truncated but silent (historical behavior, no trap)
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        exe.run(main, feed={"x": xv}, fetch_list=[g])
+
+    # debug path: the consistency check must fire
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2), flags.flag_guard(check_nan_inf=True):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup)
+        with pytest.raises(Exception, match="max_trip_count"):
+            exe2.run(main, feed={"x": xv}, fetch_list=[g])
+
+    # a sufficient bound passes the check under the same flag
+    main3, startup3, x3, loss3 = _while_sum_program(8)
+    with program_guard(main3, startup3):
+        g3, = backward.calc_gradient(loss3, [x3])
+    s3 = fluid.Scope()
+    with fluid.scope_guard(s3), flags.flag_guard(check_nan_inf=True):
+        exe3 = fluid.Executor(fluid.CPUPlace())
+        exe3.run(startup3)
+        exe3.run(main3, feed={"x": xv}, fetch_list=[g3])
+
+
+def test_conditional_block_grad_self_overwriting_predicate():
+    """CondSnapshots must be captured BEFORE the block's writes land in the
+    trace env: a sub-block that flips its OWN predicate var must still
+    differentiate the branch that actually ran (the entry-time one)."""
+    from paddle_tpu import backward
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        flag = fluid.layers.fill_constant(shape=[1], dtype="bool",
+                                          value=True)
+        out_v = fluid.layers.fill_constant(shape=[1, 4], dtype="float32",
+                                           value=0.0)
+        cb = fluid.layers.ConditionalBlock([flag], is_scalar_condition=True)
+        with cb.block():
+            fluid.layers.assign(fluid.layers.scale(x, scale=3.0), out_v)
+            # the block disables itself for any later pass
+            fluid.layers.assign(fluid.layers.fill_constant(
+                shape=[1], dtype="bool", value=False), flag)
+        loss = fluid.layers.mean(out_v)
+        g, = backward.calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        gv, = exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
+                      fetch_list=[g])
+    # true branch ran: d mean(3x)/dx = 3/4 — a post-update snapshot would
+    # replay the FALSE branch and return zeros
+    np.testing.assert_allclose(np.asarray(gv), np.full((1, 4), 0.75),
+                               rtol=1e-6)

@@ -392,3 +392,44 @@ def test_pack_small_state_parity():
     for n in st0:
         np.testing.assert_allclose(st0[n], st1[n], rtol=1e-4, atol=1e-6,
                                    err_msg=n)
+
+
+def test_pack_small_state_memo_releases_dead_scope_buffers():
+    """The packed-buffer reuse memo must hold the scope's unpacked views as
+    WEAK refs: once the scope (the strong owner) is dropped, every memo
+    entry — and with it the packed device buffer — must be evicted instead
+    of riding in the executor's compile cache forever."""
+    import gc
+    import paddle_tpu as fluid
+    from paddle_tpu import flags
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4, act="tanh")
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.Momentum(learning_rate=0.01,
+                                 momentum=0.9).minimize(loss)
+    feeds = [{"x": np.random.RandomState(i).randn(2, 4).astype("float32")}
+             for i in range(4)]
+
+    with flags.flag_guard(pack_small_state=True):
+        e = fluid.Executor(fluid.CPUPlace())
+        s = fluid.Scope()
+        with fluid.scope_guard(s):
+            e.run(startup)
+            e.run(main, feed=feeds[:2], fetch_list=[loss], iters=2)
+        memos = [en[5] for en in e._compile_cache.values()
+                 if len(en) == 6 and en[3] is not None]
+        assert memos and any(memos), "pack plan produced no memoized groups"
+        with fluid.scope_guard(s):
+            # steady state: the second call reuses the memoized buffers and
+            # re-memoizes its own generation without error
+            e.run(main, feed=feeds[2:], fetch_list=[loss], iters=2)
+        assert any(memos)
+        del s
+        gc.collect()
+        gc.collect()
+        assert all(not m for m in memos), \
+            "memo still pins packed buffers after the owning scope died"
