@@ -26,20 +26,29 @@ up here, r4 VERDICT weak #5):
     platform block_until_ready does not reliably block — and the measured
     window subtracts the measured scalar round-trip latency.
 
-Pipeline numbers (datapipe subsystem):
-  * pipeline_images_per_sec — the REAL end-to-end input path, now built on
-    paddle_tpu.datapipe: sharded native RecordIO source -> ParallelMap
-    uint8 decode workers -> AsyncDeviceFeeder (stacks K batches, then
+Pipeline numbers (datapipe subsystem + transfer engine):
+  * pipeline_images_per_sec — the REAL end-to-end input path: sharded
+    native RecordIO source -> ParallelMap uint8 decode workers ->
+    AsyncDeviceFeeder (stacks K batches into donated staging buffers, then
     TRANSFER_THREADS worker threads device_put whole chunks CONCURRENTLY,
-    capacity-bounded) -> Executor.run(iters=K). Parallel chunk transfers
-    are the big lever on this bench setup: the host->device link is a
-    SHARED TUNNEL whose single-stream bandwidth fluctuates ~50x between
-    runs (measured 20 MB/s - 1.6 GB/s for the same chunk), and multiple
-    in-flight streams multiply the achieved aggregate. The JSON also
-    reports pipeline_link_MBps (single-stream, measured during the run)
-    and pipeline_link_bound_img_s (the ceiling ONE stream implies) for
-    interpretation, plus per-stage busy/wait fractions from
-    DataPipe.stats() under pipeline_stage_*.
+    capacity-bounded) -> Executor.run(iters=K, async_fetch=True) with
+    depth-1 future fencing (the previous chunk's loss resolves AFTER the
+    next chunk is dispatched, so transfer and compute overlap without
+    letting the dispatch queue run deep — deep queues serialize transfers
+    against queued executions on the tunnel, ~15x degradation). The
+    headline pipeline number ships pixels as uint8 over the link
+    (WireSpec.uint8_images) with the cast+/255 decode fused into the
+    compiled scan.
+  * pipeline_wire — the SAME float32-input program driven under BOTH wire
+    formats: float32 (host-normalized floats on the link — the legacy
+    path) and uint8 (the transfer engine). Each side reports achieved
+    img/s, measured wire bytes/img, achieved link MB/s over the timed
+    window, the link-bound img/s ceiling those imply, and per-transfer-
+    lane (link0..linkN-1) bytes/busy so stream serialization on the
+    shared tunnel is visible. The tunnel's single-stream bandwidth
+    fluctuates ~50x between runs (20 MB/s - 1.6 GB/s for the same chunk);
+    pipeline_link_MBps is a one-put probe of it taken during the run and
+    pipeline_link_bound_img_s the uint8 ceiling ONE stream implies.
   * pipeline_hostpath_img_s — the SAME source -> decode -> stack ->
     feeder -> iters=K machinery, with only the device_put swapped for
     pre-staged device-resident chunks (AsyncDeviceFeeder stage_fn):
@@ -166,7 +175,28 @@ def _img_shape():
     return (224, 224, 3) if LAYOUT == "NHWC" else (3, 224, 224)
 
 
-def _decode_record(rec):
+def _build_pipeline_program(fluid):
+    """ResNet-50 train step with a FLOAT32 image input ("data"): what
+    crosses the link is the pipe's choice — host-normalized float32 (the
+    legacy path), or uint8 under WireSpec.uint8_images("data") with the
+    executor fusing the cast+/255 decode into the compiled scan. One
+    program, two wire formats: the A/B isolates the link."""
+    from paddle_tpu.models.resnet import resnet_imagenet
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img = fluid.layers.data(name="data", shape=list(_img_shape()),
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int32")
+        predict = resnet_imagenet(img, 1000, depth=50, layout=LAYOUT)
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=predict, label=label))
+        fluid.optimizer.Momentum(
+            learning_rate=0.01, momentum=0.9).minimize(loss)
+    return prog, startup, loss
+
+
+def _decode_record(rec, name="data_u8"):
     """One RecordIO record -> one decoded pre-batched feed dict (runs on
     the datapipe's ParallelMap workers)."""
     img_bytes = BATCH * 3 * 224 * 224
@@ -174,20 +204,33 @@ def _decode_record(rec):
         (BATCH,) + _img_shape())
     lbl = np.frombuffer(rec[img_bytes:], np.int64).reshape(
         BATCH, 1).astype(np.int32)
-    return {"data_u8": img, "label": lbl}
+    return {name: img, "label": lbl}
 
 
-def _build_pipe(fluid, path, K, stage_fn=None):
+def _decode_record_data(rec):
+    return _decode_record(rec, name="data")
+
+
+def _decode_record_f32(rec):
+    """The legacy wire format: normalize to float32 ON THE HOST, ship 4x
+    the bytes (what the u8 wire path removes)."""
+    d = _decode_record(rec, name="data")
+    d["data"] = d["data"].astype(np.float32) * (1.0 / 255.0)
+    return d
+
+
+def _build_pipe(fluid, path, K, stage_fn=None, decode=_decode_record,
+                wire=None):
     """The bench input pipe: sharded RecordIO source -> parallel decode ->
     async chunked device staging. batch_read=2 keeps the read-ahead small
     (each pre-batched record is ~19 MB)."""
     return (fluid.datapipe.DataPipe
             .from_recordio(path, batch_read=2)
-            .map(_decode_record, num_workers=DECODE_WORKERS)
+            .map(decode, num_workers=DECODE_WORKERS)
             .prefetch_to_device(place=fluid.TPUPlace(0), chunk=K,
                                 capacity=FEED_CAPACITY,
                                 transfer_threads=TRANSFER_THREADS,
-                                stage_fn=stage_fn))
+                                stage_fn=stage_fn, wire=wire))
 
 
 def _write_records(path, total):
@@ -204,9 +247,19 @@ def _write_records(path, total):
             w.write(img.tobytes() + lbl.tobytes())
 
 
-def _run_pipeline(fluid, feeder, warm_chunks, timed_chunks, K):
-    """Drive exe.run(iters=K) over a feeder; return achieved img/s."""
-    prog, startup, loss = _build_train_program(fluid)
+def _run_pipeline(fluid, feeder, warm_chunks, timed_chunks, K,
+                  program_builder=_build_train_program):
+    """Drive exe.run(iters=K, async_fetch=True) over a feeder with DEPTH-1
+    future fencing: chunk i's loss is resolved only after chunk i+1 has
+    been dispatched, so the feeder's next device_put overlaps the running
+    scan — but the queue never runs deeper than one chunk (deep queues
+    serialize transfers against queued executions on the tunnel, ~15x
+    degradation). Returns achieved img/s."""
+
+    def resolve(fut):
+        return float(np.asarray(fut.result()).reshape(-1)[-1])
+
+    prog, startup, loss = program_builder(fluid)
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe = fluid.Executor(fluid.TPUPlace(0))
@@ -214,18 +267,22 @@ def _run_pipeline(fluid, feeder, warm_chunks, timed_chunks, K):
         t0 = None
         n_timed = 0
         lv = None
+        pending = None
         for i, chunk in enumerate(feeder):
             if i == warm_chunks:
+                if pending is not None:  # drain before starting the clock
+                    lv = resolve(pending)
+                    pending = None
                 t0 = time.time()
-            out = exe.run(prog, feed=chunk, fetch_list=[loss],
-                          iters=K, return_numpy=False)
-            # fence each chunk with ONE scalar readback: letting dispatches
-            # queue deep while the feeder device_puts fresh chunks degrades
-            # ~15x on the tunnel (transfers serialize against queued
-            # executions); depth-1 interleaves transfer and compute cleanly
-            lv = float(np.asarray(out[0]).reshape(-1)[-1])
+            fut, = exe.run(prog, feed=chunk, fetch_list=[loss],
+                           iters=K, async_fetch=True)
+            if pending is not None:
+                lv = resolve(pending)
+            pending = fut
             if t0 is not None:
                 n_timed += 1
+        if pending is not None:
+            lv = resolve(pending)
         dt = time.time() - t0
     assert np.isfinite(lv), f"non-finite pipeline loss {lv}"
     assert n_timed == timed_chunks, (n_timed, timed_chunks)
@@ -233,18 +290,17 @@ def _run_pipeline(fluid, feeder, warm_chunks, timed_chunks, K):
 
 
 def measure_pipeline(fluid):
-    """REAL path: sharded RecordIO source -> ParallelMap decode ->
-    AsyncDeviceFeeder (TRANSFER_THREADS concurrent chunk device_puts) ->
-    iters=K scan; plus a link-bandwidth probe. Returns the achieved img/s
-    and the pipe's per-stage stats snapshot."""
+    """REAL path A/B: the float32-input program driven under both wire
+    formats (float32 legacy vs uint8 transfer engine); plus a link-
+    bandwidth probe. Returns (headline u8 img/s, probed single-stream
+    link MB/s, u8 link-bound ceiling, per-format wire report, u8 stats
+    snapshot)."""
     import jax
 
     K = PIPELINE_CHUNK
     warm_chunks = 2
     timed_chunks = max(1, PIPELINE_CHUNKS)
-    path = "/tmp/bench_pipeline.recordio"
     total = (warm_chunks + timed_chunks) * K
-    _write_records(path, total)
 
     # measure the tunnel's SINGLE-STREAM host->device bandwidth NOW (it is
     # shared and varies ~50x between runs): one chunk-sized put, fenced
@@ -255,10 +311,44 @@ def measure_pipeline(fluid):
     link_mbps = probe.nbytes / 1e6 / (time.time() - t)
     del staged_probe, probe
 
-    pipe = _build_pipe(fluid, path, K)
-    img_s = _run_pipeline(fluid, pipe, warm_chunks, timed_chunks, K)
+    formats = {
+        "float32": dict(decode=_decode_record_f32, wire=None),
+        "uint8": dict(decode=_decode_record_data,
+                      wire=fluid.datapipe.WireSpec.uint8_images("data")),
+    }
+    wire_report = {}
+    u8_img_s, u8_stats = None, None
+    for fmt, cfg in formats.items():
+        path = f"/tmp/bench_pipeline_{fmt}.recordio"
+        _write_records(path, total)
+        pipe = _build_pipe(fluid, path, K, decode=cfg["decode"],
+                           wire=cfg["wire"])
+        img_s = _run_pipeline(fluid, pipe, warm_chunks, timed_chunks, K,
+                              program_builder=_build_pipeline_program)
+        st = pipe.stats()
+        tr = st.get("transfer", {})
+        imgs_moved = tr.get("items", 0) * K * BATCH
+        bytes_per_img = tr.get("bytes", 0) / max(1, imgs_moved)
+        achieved_mbps = tr.get("MB_per_sec", 0.0)
+        wire_report[fmt] = {
+            "img_s": round(img_s, 2),
+            "wire_bytes_per_img": round(bytes_per_img, 1),
+            "link_MBps": achieved_mbps,
+            "link_bound_img_s": round(
+                achieved_mbps * 1e6 / bytes_per_img, 1)
+            if bytes_per_img and achieved_mbps else 0.0,
+            # one row per transfer lane: equal shares = streams aggregate,
+            # one hot lane = they serialize on the tunnel
+            "links": {
+                name: {"MB": round(s["bytes"] / 1e6, 1),
+                       "busy_s": s["busy_s"]}
+                for name, s in st.items()
+                if name.startswith("link") and isinstance(s, dict)},
+        }
+        if fmt == "uint8":
+            u8_img_s, u8_stats = img_s, st
     img_mb = 3 * 224 * 224 / 1e6  # uint8 bytes per image on the wire
-    return img_s, link_mbps, link_mbps / img_mb, pipe.stats()
+    return u8_img_s, link_mbps, link_mbps / img_mb, wire_report, u8_stats
 
 
 def measure_pipeline_hostpath(fluid):
@@ -329,12 +419,17 @@ def main():
             result["pipeline_hostpath_error"] = f"{type(e).__name__}: {e}"
     for attempt in range(2):
         try:
-            pipe_s, link_mbps, link_bound, stats = measure_pipeline(fluid)
+            pipe_s, link_mbps, link_bound, wire_report, stats = \
+                measure_pipeline(fluid)
             result["pipeline_images_per_sec"] = round(pipe_s, 2)
             result["pipeline_frac_of_device"] = round(pipe_s / img_s, 3)
             result["pipeline_link_MBps"] = round(link_mbps, 1)
             result["pipeline_link_bound_img_s"] = round(link_bound, 1)
             result["pipeline_transfer_threads"] = TRANSFER_THREADS
+            # the wire A/B: same float32-input program, float32 vs uint8
+            # on the link (wire_bytes_per_img, per-format link MB/s and
+            # the ceiling it implies, per-lane bytes/busy)
+            result["pipeline_wire"] = wire_report
             # per-stage observability (datapipe.stats): where the pipeline
             # time went — map.wait_in ~ raw read, map.busy ~ decode,
             # stack.busy ~ chunk assembly, transfer.busy ~ device_put;

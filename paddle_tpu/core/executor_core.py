@@ -349,8 +349,31 @@ def build_step_fn(program, fetch_names, state_out_names, is_test=False):
     return step
 
 
-def compile_step_fn(step, donate_state=True):
-    return jax.jit(step, donate_argnums=(0,) if donate_state else ())
+def compile_step_fn(step, donate_state=True, donate_feeds=False):
+    """jit the step. donate_state aliases mut_state so parameters update in
+    place; donate_feeds ALSO donates the feeds argument — correct only for
+    single-use staged chunks (datapipe transfer engine marks them with
+    DONATE_KEY), where it lets XLA reclaim the chunk's staging memory for
+    the next transfer instead of holding it to the end of the dispatch.
+    Feed buffers rarely alias an output shape, and jax warns at lowering
+    about every non-aliasable donated buffer; calls run with that warning
+    suppressed (lowering happens on first call, so the jit() site can't
+    scope it) because early reuse of the staging memory — not output
+    aliasing — is the point of donating feeds."""
+    donate = (0,) if donate_state else ()
+    if not donate_feeds:
+        return jax.jit(step, donate_argnums=donate)
+    compiled = jax.jit(step, donate_argnums=donate + (2,))
+
+    def call(*args):
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return compiled(*args)
+
+    return call
 
 
 def collect_ema_states(program, state_out_names, fetch_names=()):

@@ -23,6 +23,8 @@ from .pipeline import DataPipe
 from .source import (GeneratorSource, RecordIOSource, Source,
                      default_shard_assignment)
 from .stats import PipeStats, StageStats
+from .transfer import (DONATE_KEY, WIRE_KEY, WireFormat, WireSpec,
+                       pop_markers)
 
 __all__ = [
     "DataPipe",
@@ -35,4 +37,9 @@ __all__ = [
     "AsyncDeviceFeeder",
     "PipeStats",
     "StageStats",
+    "WireFormat",
+    "WireSpec",
+    "WIRE_KEY",
+    "DONATE_KEY",
+    "pop_markers",
 ]

@@ -5,7 +5,9 @@ Two tail modes (reference create_batch_reader_op.cc only drops):
                           no recompile; the DeviceChunkFeeder behavior)
   pad_to_batch=True     — the partial batch is padded by repeating its last
                           sample up to batch_size; the yielded dict carries
-                          "__valid__": n_real so consumers can mask
+                          "__valid__": a [batch_size] bool_ mask (True for
+                          real rows) so consumers can exclude the pad rows
+                          from mean-reduced losses/metrics
 
 Staging buffers are C-contiguous np arrays allocated ONCE per ring slot and
 refilled in place — the allocation-per-batch the naive np.stack path pays is
@@ -65,7 +67,10 @@ class Batcher:
                 out = buf if self._zero_copy else buf.copy()
                 batch[name] = out
             if self._pad:
-                batch["__valid__"] = np.asarray(n_valid, np.int32)
+                # bool_ end to end: feeds straight into a masked-mean loss
+                # (cast(mask) -> 0/1 weights) without a host-side compare,
+                # and bool_ is what every consumer dtype-checks against
+                batch["__valid__"] = np.arange(self._bs) < n_valid
             if st:
                 st.add_item(nbytes=sum(
                     b.nbytes for k, b in batch.items() if k != "__valid__"))

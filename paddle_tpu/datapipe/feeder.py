@@ -21,6 +21,15 @@ What's new over DeviceChunkFeeder:
     buffers — backpressure all the way to the source.
   * per-stage stats (stack/transfer busy, consumer starvation) and
     profiler counter tracks.
+
+Transfer engine (see transfer.py): with `wire=WireSpec(...)` each batch is
+encoded into the staging buffer in its WIRE dtype (uint8 pixels, bf16
+floats) so the device_put moves the compressed representation; staged
+chunks carry the spec (WIRE_KEY) so the executor fuses the decode into the
+compiled step. Chunks the feeder staged itself are marked single-use
+(DONATE_KEY) so the executor may donate their buffers back to XLA. Each
+transfer thread is one LINK LANE: pass `link_stats` (index -> StageStats)
+to get per-lane bytes/busy in DataPipe.stats() and the profiler host lane.
 """
 
 import threading
@@ -28,6 +37,7 @@ import threading
 import numpy as np
 
 from ..flags import define, get as get_flag
+from .transfer import DONATE_KEY, WIRE_KEY
 
 __all__ = ["AsyncDeviceFeeder"]
 
@@ -71,16 +81,28 @@ class AsyncDeviceFeeder:
     stage_fn:         override for the staging step, stage_fn(idx, stacked)
                       -> {name: device_array}; disables buffer reuse since
                       the callee may keep host references
+    wire:             optional transfer.WireSpec — covered feeds are staged
+                      and shipped in their wire dtype; emitted chunks carry
+                      the spec under WIRE_KEY so the executor fuses the
+                      decode into the compiled step
+    donate:           mark emitted chunks single-use (DONATE_KEY) so the
+                      executor may donate their device buffers; None = auto
+                      (on unless stage_fn, whose chunks the callee owns and
+                      may hand out again)
     stack_stats /     optional StageStats receiving the stack-copy and
     transfer_stats:   transfer/starvation counters
+    link_stats:       per-transfer-thread lane stats — a callable
+                      (thread index -> StageStats) or a list; each lane
+                      records its own bytes/busy
 
     A partial tail chunk is dropped (odd [K', ...] shapes would force an
     extra XLA compile), matching DeviceChunkFeeder.
     """
 
     def __init__(self, source, chunk=None, place=None, capacity=2,
-                 transfer_threads=None, stage_fn=None, stack_stats=None,
-                 transfer_stats=None):
+                 transfer_threads=None, stage_fn=None, wire=None,
+                 donate=None, stack_stats=None, transfer_stats=None,
+                 link_stats=None):
         if chunk is not None and int(chunk) < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if int(capacity) < 2:
@@ -99,8 +121,12 @@ class AsyncDeviceFeeder:
                 f"transfer_threads must be >= 1, got {transfer_threads}")
         self._threads = min(int(transfer_threads), self._cap)
         self._stage_fn = stage_fn
+        self._wire = wire
+        self._donate = bool(stage_fn is None) if donate is None \
+            else bool(donate)
         self._stack_stats = stack_stats
         self._transfer_stats = transfer_stats
+        self._link_stats = link_stats
         self._active = None  # stop flag of the live iteration (for close())
 
     def _device(self):
@@ -135,8 +161,17 @@ class AsyncDeviceFeeder:
                  "error": None, "stop": False, "ended": 0, "cond": cond}
         self._active = state
         sst, tst = self._stack_stats, self._transfer_stats
+        wire = self._wire
         puts_copy = self._stage_fn is not None or _device_put_copies(dev)
         reuse_buffers = self._stage_fn is None and puts_copy
+
+        def link_stat(i):
+            ls = self._link_stats
+            if ls is None:
+                return None
+            if callable(ls):
+                return ls(i)
+            return ls[i] if i < len(ls) else None
 
         def fail(e):
             with cond:
@@ -163,6 +198,8 @@ class AsyncDeviceFeeder:
                             with cond:
                                 cond.notify_all()
                             return None
+                        if wire is not None:
+                            item = wire.encode_feed(item)
                         # copy when device_put would alias the host array
                         # (the upstream reader may reuse it between items)
                         stacked = {n: np.asarray(a) if puts_copy
@@ -188,20 +225,30 @@ class AsyncDeviceFeeder:
                                 return None
                             tb = time.perf_counter()
                             if buf is None:
-                                buf = buf_holder[0] = {
-                                    n: np.empty(
-                                        (K,) + np.asarray(a).shape,
-                                        np.asarray(a).dtype)
-                                    for n, a in item.items()
-                                    if not n.startswith("__")}
+                                # __valid__ (the Batcher's pad mask) is a
+                                # real [bs] bool array and rides the chunk;
+                                # other __ metadata stays host-side
+                                buf = buf_holder[0] = {}
+                                for n, a in item.items():
+                                    if n.startswith("__") \
+                                            and n != "__valid__":
+                                        continue
+                                    a = np.asarray(a)
+                                    dt = wire.wire_dtype(n, a) \
+                                        if wire is not None else a.dtype
+                                    buf[n] = np.empty((K,) + a.shape, dt)
                             for n, b in buf.items():
-                                b[got] = item[n]
+                                v = item[n]
+                                if wire is not None and n in wire:
+                                    v = wire[n].encode(v)
+                                b[got] = v
                             got += 1
                             if sst:
+                                # wire bytes: what the link will move
                                 sst.add_item(
                                     busy_s=time.perf_counter() - tb,
-                                    nbytes=sum(np.asarray(item[n]).nbytes
-                                               for n in buf))
+                                    nbytes=sum(b[0].nbytes
+                                               for b in buf.values()))
                         if reuse_buffers:
                             stacked = buf
                         else:
@@ -213,7 +260,7 @@ class AsyncDeviceFeeder:
                 state["next_in"] += 1
                 return idx, stacked
 
-        def work():
+        def work(lst):
             # buf_holder: this worker's private staging buffers — safe to
             # refill once its previous transfer has completed (we block on
             # the transfer below before looping)
@@ -230,19 +277,42 @@ class AsyncDeviceFeeder:
                     idx, stacked = nxt
                     try:
                         t0 = time.perf_counter()
-                        if self._stage_fn is not None:
-                            staged = self._stage_fn(idx, stacked)
-                        else:
+
+                        def stage():
+                            if self._stage_fn is not None:
+                                return self._stage_fn(idx, stacked)
                             staged = {n: jax.device_put(a, dev)
                                       for n, a in stacked.items()}
                             # wait for the copy out of our staging buffer
                             # (also what makes transfer busy_s honest)
                             jax.block_until_ready(staged)
+                            return staged
+
+                        if lst is not None:
+                            with lst.span():
+                                staged = stage()
+                        else:
+                            staged = stage()
+                        dt = time.perf_counter() - t0
+                        nb = sum(a.nbytes for a in stacked.values())
                         if tst:
-                            tst.add_item(
-                                busy_s=time.perf_counter() - t0,
-                                nbytes=sum(a.nbytes
-                                           for a in stacked.values()))
+                            tst.add_item(busy_s=dt, nbytes=nb)
+                        if lst is not None:
+                            lst.add_item(busy_s=dt, nbytes=nb)
+                            from .. import profiler
+
+                            profiler.record_bytes(
+                                f"datapipe/{lst.name}", nb)
+                        # transfer-engine metadata: the executor pops both
+                        # (pop_markers); stage_fn chunks are callee-owned,
+                        # so copy before annotating and never mark donate
+                        if wire is not None or self._donate:
+                            if self._stage_fn is not None:
+                                staged = dict(staged)
+                            if wire is not None:
+                                staged[WIRE_KEY] = wire
+                            if self._donate:
+                                staged[DONATE_KEY] = True
                     except BaseException as e:
                         fail(e)
                         return
@@ -254,7 +324,8 @@ class AsyncDeviceFeeder:
                     state["ended"] += 1
                     cond.notify_all()
 
-        threads = [threading.Thread(target=work, daemon=True,
+        threads = [threading.Thread(target=work, args=(link_stat(i),),
+                                    daemon=True,
                                     name=f"datapipe-feed-{i}")
                    for i in range(self._threads)]
         for t in threads:

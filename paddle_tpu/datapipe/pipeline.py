@@ -115,14 +115,23 @@ class DataPipe:
                                            ring=ring)))
 
     def prefetch_to_device(self, place=None, chunk=None, capacity=2,
-                           transfer_threads=None, stage_fn=None):
+                           transfer_threads=None, stage_fn=None, wire=None,
+                           donate=None):
         """Terminal stage: background host->device staging (see
         AsyncDeviceFeeder). chunk=K stacks K batches per staged item for
-        Executor.run(iters=K); Executor reads K off .feed_iters."""
+        Executor.run(iters=K); Executor reads K off .feed_iters.
+
+        wire=WireSpec(...) ships covered feeds in their compressed wire
+        dtype (uint8 pixels cut link bytes 4x vs float32) and the executor
+        fuses the cast+normalize decode into the compiled step; donate
+        marks staged chunks single-use so their device buffers are donated
+        back to XLA across dispatches (None = auto, see AsyncDeviceFeeder).
+        """
         return self._derive(("device", dict(place=place, chunk=chunk,
                                             capacity=capacity,
                                             transfer_threads=transfer_threads,
-                                            stage_fn=stage_fn)))
+                                            stage_fn=stage_fn, wire=wire,
+                                            donate=donate)))
 
     # -- execution -------------------------------------------------------
     @property
@@ -132,6 +141,15 @@ class DataPipe:
         for kind, kw in self._ops:
             if kind == "device" and kw["chunk"] is not None:
                 return kw["chunk"]
+        return None
+
+    @property
+    def wire_spec(self):
+        """The prefetch_to_device stage's WireSpec (None when the pipe
+        ships feeds uncompressed)."""
+        for kind, kw in self._ops:
+            if kind == "device":
+                return kw.get("wire")
         return None
 
     def _stage(self, i, name):
@@ -157,7 +175,12 @@ class DataPipe:
             elif kind == "device":
                 cur = iter(AsyncDeviceFeeder(
                     cur, stack_stats=self._stage(i, "stack"),
-                    transfer_stats=self._stage(i, "transfer"), **kw))
+                    transfer_stats=self._stage(i, "transfer"),
+                    # one lane per transfer thread: link0..linkN-1 rows in
+                    # stats() show whether the streams share the link's
+                    # bandwidth or serialize on it
+                    link_stats=lambda t, _i=i: self._stage(_i, f"link{t}"),
+                    **kw))
             else:  # pragma: no cover - builder invariant
                 raise AssertionError(f"unknown op {kind!r}")
             layers.append(cur)

@@ -22,9 +22,17 @@ from .core.places import CPUPlace, TPUPlace, jax_device_for
 from .core.scope import global_scope, Scope
 from .core.registry import SeqTensor
 
-__all__ = ["Executor", "global_scope", "scope_guard", "fetch_var"]
+__all__ = ["Executor", "FetchFuture", "global_scope", "scope_guard",
+           "fetch_var"]
 
 from .core.scope import scope_guard  # re-export (reference executor.py:39)
+
+flags.define(
+    "donate_feed_buffers", bool, True,
+    "Donate single-use staged feed chunks (datapipe transfer engine marks "
+    "them) to the compiled step so XLA reclaims their staging HBM for the "
+    "next transfer instead of holding it across the dispatch. Off: staged "
+    "chunks stay readable after run() (debugging).")
 
 
 def jnp_ravel_first(leaf):
@@ -112,7 +120,7 @@ def _program_has_host_ops(program):
     return False
 
 
-def stack_multi_step_feeds(program, feed, iters):
+def stack_multi_step_feeds(program, feed, iters, wire=None):
     """list-of-dicts -> one dict of [K, ...] jnp arrays for an iters=K scan
     (shared by Executor and ParallelExecutor); a dict is trusted to be
     pre-stacked (leading axis == iters, checked). Sequence feeds ride too:
@@ -121,7 +129,10 @@ def stack_multi_step_feeds(program, feed, iters):
     so lax.scan slices the leading axis of data and lengths together.
     Ragged feeds whose shapes differ across steps are rejected with a
     pointer to the bucketing bridge. Dense feeds cast to each program
-    var's declared dtype."""
+    var's declared dtype — except names covered by a datapipe WireSpec,
+    which cross the link in their compact wire dtype and are decoded
+    inside the compiled step (per scan iteration, so the full-width
+    tensor never materialises as [K, ...] in HBM)."""
     import jax.numpy as jnp
 
     if isinstance(feed, (list, tuple)):
@@ -188,10 +199,52 @@ def stack_multi_step_feeds(program, feed, iters):
                 f"iters {iters} (pre-stacked feeds carry [K, ...])")
         tv = jnp.asarray(tv)
         if var is not None and var.dtype is not None \
-                and str(tv.dtype) != var.dtype:
+                and str(tv.dtype) != var.dtype \
+                and not (wire is not None and name in wire):
             tv = tv.astype(var.dtype)
         vals[name] = tv
     return vals
+
+
+class FetchFuture:
+    """Handle to one in-flight fetch from run(async_fetch=True).
+
+    jax dispatch is asynchronous, so the computation is already running on
+    the device when run() returns; what a future defers is the HOST
+    READBACK. Holding futures lets the caller overlap the next chunk's
+    transfer and dispatch with the current scan instead of fencing on a
+    device_get every call — fence at most one chunk behind (depth-1
+    pipelining) by calling result() on the previous chunk's future.
+
+    value    — the device-side array (or LoDTensor for sequence fetches)
+    done()   — True once the device value is computed (no blocking)
+    result() — block and return the host value (numpy, matching
+               return_numpy=True semantics); cached after the first call
+    """
+
+    __slots__ = ("_value", "_host")
+
+    def __init__(self, value):
+        self._value = value
+        self._host = None
+
+    @property
+    def value(self):
+        return self._value
+
+    def done(self):
+        if self._host is not None:
+            return True
+        v = self._value
+        if isinstance(v, SeqTensor):
+            v = v.data
+        is_ready = getattr(v, "is_ready", None)
+        return bool(is_ready()) if callable(is_ready) else True
+
+    def result(self):
+        if self._host is None:
+            self._host = as_numpy(self._value)
+        return self._host
 
 
 class Executor:
@@ -218,6 +271,8 @@ class Executor:
         return_numpy=True,
         use_program_cache=True,
         iters=None,
+        async_fetch=False,
+        donate_feeds=None,
     ):
         """Run the program once — or, with `iters=K`, K steps in ONE device
         dispatch (a jit'd lax.scan over the step; the TPU-idiomatic host
@@ -231,6 +286,20 @@ class Executor:
         the executor pulls the next prefetched chunk itself and defaults
         iters to the pipe's chunk size (feed_iters). The pipe's
         StopIteration propagates when it is exhausted.
+
+        Transfer-engine markers riding in a staged chunk (datapipe
+        WIRE_KEY / DONATE_KEY) are honoured: wire-compressed feeds are
+        decoded inside the compiled step (cast+scale fused into the scan),
+        and single-use chunks are donated so XLA reuses their staging
+        memory. `donate_feeds` overrides the chunk's marker (None = follow
+        the marker); the FLAGS_donate_feed_buffers flag gates donation
+        globally.
+
+        `async_fetch=True` returns a list of FetchFuture instead of host
+        arrays: the dispatch has happened, but the host readback is
+        deferred until .result(), so the caller can overlap the next
+        chunk's transfer with this chunk's compute (return_numpy is
+        ignored in that case).
         """
         if program is None:
             program = default_main_program()
@@ -243,6 +312,12 @@ class Executor:
         if isinstance(feed, (list, tuple)) and iters is None:
             iters = len(feed)  # length consistency checked in the helper
         feed = feed if feed is not None else {}
+        from .datapipe.transfer import pop_markers
+        feed, wire, chunk_donate = pop_markers(feed)
+        if donate_feeds is None:
+            donate_feeds = chunk_donate
+        donate_feeds = bool(donate_feeds) \
+            and bool(flags.get("donate_feed_buffers"))
         fetch_list = fetch_list or []
         fetch_names = [
             v.name if isinstance(v, Variable) else str(v) for v in fetch_list
@@ -264,30 +339,51 @@ class Executor:
                         "step-by-step)")
                 outs = self._run_compiled_multi(
                     program, scope, feed, fetch_names, use_program_cache,
-                    iters)
+                    iters, wire=wire, donate_feeds=donate_feeds)
             elif _program_has_host_ops(program):
-                outs = self._run_eager(program, scope, feed, fetch_names)
+                outs = self._run_eager(program, scope, feed, fetch_names,
+                                       wire=wire)
             else:
                 outs = self._run_compiled(
-                    program, scope, feed, fetch_names, use_program_cache
-                )
+                    program, scope, feed, fetch_names, use_program_cache,
+                    wire=wire, donate_feeds=donate_feeds)
+        if async_fetch:
+            return [FetchFuture(o) for o in outs]
         if return_numpy:
             return [as_numpy(o) for o in outs]
         return outs
 
     # ------------------------------------------------------------------
-    def _feed_values(self, program, feed):
+    def _feed_values(self, program, feed, wire=None, decode_eager=False):
         vals = {}
         gb = program.global_block()
         for name, value in feed.items():
             var = gb.vars.get(name)
             tv = executor_core.feed_to_tracevalue(value, var)
-            if var is not None and not isinstance(tv, SeqTensor):
+            wired = wire is not None and name in wire \
+                and not isinstance(tv, SeqTensor)
+            if wired and decode_eager:
+                # eager (host-op) programs have no compiled step to fuse
+                # the decode into; decode at feed time instead
+                tv = wire[name].decode(
+                    tv, var.dtype if var is not None else None)
+                wired = False
+            if var is not None and not isinstance(tv, SeqTensor) \
+                    and not wired:
                 want = var.dtype
                 if str(tv.dtype) != want and want is not None:
                     tv = tv.astype(want)
             vals[name] = tv
         return vals
+
+    def _wire_var_dtypes(self, program, wire):
+        gb = program.global_block()
+        out = {}
+        for n in wire:
+            var = gb.vars.get(n)
+            if var is not None and var.dtype is not None:
+                out[n] = var.dtype
+        return out
 
     def _rng_for(self, program):
         key = id(program)
@@ -296,9 +392,12 @@ class Executor:
         return jax.random.fold_in(jax.random.PRNGKey(program.random_seed), step)
 
     # ------------------------------------------------------------------
-    def _run_compiled(self, program, scope, feed, fetch_names, use_cache):
-        feed_vals = self._feed_values(program, feed)
+    def _run_compiled(self, program, scope, feed, fetch_names, use_cache,
+                      wire=None, donate_feeds=False):
+        feed_vals = self._feed_values(program, feed, wire=wire)
         state_names, state_out_names = executor_core.collect_state_names(program, scope)
+        if flags.get("debug_nans"):
+            donate_feeds = False  # re-run needs the inputs (see below)
         cache_key = (
             id(program),
             program._mutation,
@@ -308,16 +407,22 @@ class Executor:
             amp.fingerprint(),
             flags.get("fuse_optimizer_ops"),  # trace-affecting, like amp
             flags.get("debug_nans"),  # changes donation (see below)
+            ("wire", wire.fingerprint() if wire is not None else None),
+            ("donate_feeds", donate_feeds),
         )
         entry = self._compile_cache.get(cache_key) if use_cache else None
         if entry is None:
             step = executor_core.build_step_fn(program, fetch_names, state_out_names)
+            if wire is not None:
+                step = wire.wrap_step(
+                    step, var_dtypes=self._wire_var_dtypes(program, wire))
             # under debug_nans the trap fires INSIDE compiled() before the
             # scope write-back; donated buffers would already be deleted,
             # wrecking both the scope and jax's op-by-op re-run — so trade
             # the in-place update away while the sanitizer is on
             compiled = executor_core.compile_step_fn(
-                step, donate_state=not flags.get("debug_nans"))
+                step, donate_state=not flags.get("debug_nans"),
+                donate_feeds=donate_feeds)
             entry = (compiled, state_names, state_out_names)
             if use_cache:
                 self._compile_cache[cache_key] = entry
@@ -367,12 +472,12 @@ class Executor:
                 context=" after compiled step")
         return [self._to_host(f) for f in fetches]
 
-    def _stack_feeds(self, program, feed, iters):
-        return stack_multi_step_feeds(program, feed, iters)
+    def _stack_feeds(self, program, feed, iters, wire=None):
+        return stack_multi_step_feeds(program, feed, iters, wire=wire)
 
     def _run_compiled_multi(self, program, scope, feed, fetch_names,
-                            use_cache, iters):
-        feed_vals = self._stack_feeds(program, feed, iters)
+                            use_cache, iters, wire=None, donate_feeds=False):
+        feed_vals = self._stack_feeds(program, feed, iters, wire=wire)
         state_names, state_out_names = executor_core.collect_state_names(
             program, scope)
         missing = [n for n in state_out_names if not scope.has_var(n)]
@@ -382,6 +487,8 @@ class Executor:
                 f"before the scan (the carry structure is fixed); missing: "
                 f"{missing}. Run the startup program (or one plain "
                 f"exe.run) first.")
+        if flags.get("debug_nans"):
+            donate_feeds = False  # the op-by-op re-run needs the inputs
         cache_key = (
             id(program),
             program._mutation,
@@ -395,6 +502,8 @@ class Executor:
             flags.get("fold_ema_multi_step"),
             flags.get("pack_small_state"),
             ("iters", iters),
+            ("wire", wire.fingerprint() if wire is not None else None),
+            ("donate_feeds", donate_feeds),
         )
         out_set = set(state_out_names)
         mut_state, const_state = {}, {}
@@ -408,6 +517,13 @@ class Executor:
         if entry is None:
             step = executor_core.build_step_fn(
                 program, fetch_names, state_out_names)
+            if wire is not None:
+                # decode INSIDE the per-step fn: the scan slices the compact
+                # [K, ...] wire chunk and each iteration casts/scales only
+                # its own step's slice — the full-width tensor never exists
+                # as [K, ...] in device memory
+                step = wire.wrap_step(
+                    step, var_dtypes=self._wire_var_dtypes(program, wire))
             ema = executor_core.collect_ema_states(
                 program, state_out_names, fetch_names) \
                 if flags.get("fold_ema_multi_step") else {}
@@ -420,7 +536,8 @@ class Executor:
                     plan = None
             multi = executor_core.build_multi_step_fn(step, iters, ema=ema)
             compiled = executor_core.compile_step_fn(
-                multi, donate_state=not flags.get("debug_nans"))
+                multi, donate_state=not flags.get("debug_nans"),
+                donate_feeds=donate_feeds)
             unpackers = {}
             if plan is not None:
                 for g in plan.groups:
@@ -523,8 +640,9 @@ class Executor:
                     scope.var(n)
                     scope.set_var(n, env[n])
 
-    def _run_eager(self, program, scope, feed, fetch_names):
-        feed_vals = self._feed_values(program, feed)
+    def _run_eager(self, program, scope, feed, fetch_names, wire=None):
+        feed_vals = self._feed_values(program, feed, wire=wire,
+                                      decode_eager=True)
         env = {}
         touched = set()
         for b in program.blocks:

@@ -166,20 +166,31 @@ class ParallelExecutor:
 
     # ------------------------------------------------------------------
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True,
-            iters=None):
+            iters=None, async_fetch=False, donate_feeds=None):
         """One data-parallel step over the mesh — or, with `iters=K`, K
         steps inside ONE jit'd lax.scan dispatch (feeds carry a leading
         [K] axis, batch sharded over "dp" on axis 1; fetches come back
         stacked [K, ...]). Same contract as Executor.run(iters=K).
 
         `feed` may be a datapipe.DataPipe: the next prefetched chunk is
-        pulled here and iters defaults to the pipe's chunk size."""
+        pulled here and iters defaults to the pipe's chunk size. Transfer-
+        engine markers (WIRE_KEY/DONATE_KEY) riding a staged chunk are
+        honoured the same way as Executor.run: wire decode fused into the
+        compiled step, single-use chunks donated. `async_fetch=True`
+        returns FetchFuture handles instead of host arrays."""
         _apply_debug_nans()
         feed = feed if feed is not None else feed_dict
         if hasattr(feed, "next_feed"):  # datapipe.DataPipe (duck-typed)
             if iters is None:
                 iters = getattr(feed, "feed_iters", None)
             feed = feed.next_feed()
+        from .datapipe.transfer import pop_markers
+        feed, wire, chunk_donate = pop_markers(feed)
+        if donate_feeds is None:
+            donate_feeds = chunk_donate
+        donate_feeds = bool(donate_feeds) \
+            and bool(flags.get("donate_feed_buffers")) \
+            and not flags.get("debug_nans")
         if isinstance(feed, list) and iters is None:
             # per-device feed list (reference feed_parallel): concatenate
             merged = {}
@@ -200,7 +211,7 @@ class ParallelExecutor:
 
             for name, value in stack_multi_step_feeds(
                     program, feed if feed is not None else {},
-                    iters).items():
+                    iters, wire=wire).items():
                 feed_vals[name] = self._feed_sharding(
                     value, leading_steps=True)
         else:
@@ -220,10 +231,20 @@ class ParallelExecutor:
             flags.get("fuse_optimizer_ops"),  # trace-affecting, like amp
             flags.get("debug_nans"),  # changes donation, like Executor
             ("iters", iters),
+            ("wire", wire.fingerprint() if wire is not None else None),
+            ("donate_feeds", donate_feeds),
         )
         entry = self._compile_cache.get(cache_key)
         if entry is None:
             step = executor_core.build_step_fn(program, fetch_names, state_out_names)
+            if wire is not None:
+                # decode in the PER-STEP fn (before the scan wrapper), so
+                # each iteration widens only its own [batch, ...] slice
+                gb = program.global_block()
+                var_dtypes = {
+                    n: gb.vars[n].dtype for n in wire
+                    if n in gb.vars and gb.vars[n].dtype is not None}
+                step = wire.wrap_step(step, var_dtypes=var_dtypes)
             if iters is not None:
                 missing = [n for n in state_out_names
                            if not scope.has_var(n)]
@@ -233,8 +254,9 @@ class ParallelExecutor:
                         f"scope before the scan; missing: {missing}. Run "
                         f"the startup program first.")
                 step = executor_core.build_multi_step_fn(step, iters)
-            donate = () if flags.get("debug_nans") else (0,)
-            compiled = jax.jit(step, donate_argnums=donate)
+            compiled = executor_core.compile_step_fn(
+                step, donate_state=not flags.get("debug_nans"),
+                donate_feeds=donate_feeds)
             entry = (compiled, state_names, state_out_names)
             self._compile_cache[cache_key] = entry
         compiled, state_names, state_out_names = entry
@@ -296,6 +318,10 @@ class ParallelExecutor:
             executor_core.value_to_lod_tensor(f) if isinstance(f, SeqTensor) else f
             for f in fetches
         ]
+        if async_fetch:
+            from .executor import FetchFuture
+
+            return [FetchFuture(o) for o in outs]
         if return_numpy:
             return [as_numpy(o) for o in outs]
         return outs

@@ -12,6 +12,12 @@ import warnings
 
 __all__ = ["DeviceChunkFeeder"]
 
+# once per process, not per instantiation: Trainer loops rebuild their
+# feeder every pass, and the default "default" warning filter dedupes by
+# code location only per-module-registry, which user warning config
+# (-W always, pytest filters) routinely defeats
+_deprecation_warned = False
+
 
 class DeviceChunkFeeder:
     """Deprecated: use datapipe.AsyncDeviceFeeder / DataPipe.
@@ -34,17 +40,22 @@ class DeviceChunkFeeder:
     """
 
     def __init__(self, reader, chunk, place=None, capacity=2, stage_fn=None):
-        warnings.warn(
-            "pipeline.DeviceChunkFeeder is deprecated; use "
-            "datapipe.AsyncDeviceFeeder (or DataPipe.prefetch_to_device)",
-            DeprecationWarning, stacklevel=2)
+        global _deprecation_warned
+        if not _deprecation_warned:
+            _deprecation_warned = True
+            warnings.warn(
+                "pipeline.DeviceChunkFeeder is deprecated; use "
+                "datapipe.AsyncDeviceFeeder (or DataPipe.prefetch_to_device)",
+                DeprecationWarning, stacklevel=2)
         from .datapipe import AsyncDeviceFeeder
 
         if int(chunk) < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        # donate=False: the legacy contract yields plain {name: array}
+        # chunk dicts with no transfer-engine metadata riding along
         self._feeder = AsyncDeviceFeeder(
             reader, chunk=chunk, place=place, capacity=max(2, int(capacity)),
-            transfer_threads=1, stage_fn=stage_fn)
+            transfer_threads=1, stage_fn=stage_fn, donate=False)
 
     def __iter__(self):
         return iter(self._feeder)

@@ -14,10 +14,11 @@ import jax
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
            "stop_profiler", "record_event", "record_counter",
-           "export_chrome_trace"]
+           "record_bytes", "export_chrome_trace"]
 
 _host_events = []  # (name, start, end)
 _counter_events = []  # (name, t, value) — chrome-trace "C" counter samples
+_byte_totals = defaultdict(float)  # name -> cumulative bytes (record_bytes)
 _enabled = False
 _trace_dir = None
 _last_trace_dir = None  # survives stop_profiler so export can merge
@@ -52,10 +53,21 @@ def record_counter(name, value):
         _counter_events.append((name, time.perf_counter(), float(value)))
 
 
+def record_bytes(name, nbytes):
+    """Accumulate a named byte flow (e.g. one datapipe transfer lane's link
+    bytes); rendered as a cumulative MB counter track in the merged chrome
+    trace, so per-link throughput reads off the track's slope."""
+    if _enabled:
+        _byte_totals[name] += float(nbytes)
+        _counter_events.append(
+            (name + "/MB", time.perf_counter(), _byte_totals[name] / 1e6))
+
+
 def reset_profiler():
     global _last_trace_dir, _trace_t0
     del _host_events[:]
     del _counter_events[:]
+    _byte_totals.clear()
     _last_trace_dir = None
     _trace_t0 = None
 

@@ -50,3 +50,30 @@ def _fresh_programs():
 @pytest.fixture
 def rng():
     return np.random.RandomState(1234)
+
+
+@pytest.fixture
+def no_datapipe_thread_leaks():
+    """Fail THE TEST (not the session) if it leaks datapipe worker threads
+    (datapipe-map-*/datapipe-feed-* — decode and transfer lanes). Stages
+    reap their daemons on exhaustion and on close(); a survivor means a
+    worker is wedged on a queue. Opt in per module with
+    pytest.mark.usefixtures so unrelated suites don't pay the drain wait."""
+    import threading
+    import time
+
+    def _datapipe_threads():
+        return {t for t in threading.enumerate()
+                if t.is_alive() and t.name.startswith("datapipe-")}
+
+    before = _datapipe_threads()
+    yield
+    deadline = time.time() + 5.0
+    leaked = _datapipe_threads() - before
+    while leaked and time.time() < deadline:
+        time.sleep(0.05)
+        leaked = _datapipe_threads() - before
+    if leaked:
+        pytest.fail(
+            "leaked datapipe threads: "
+            f"{sorted(t.name for t in leaked)}", pytrace=False)

@@ -10,9 +10,13 @@ import threading
 import time
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import datapipe, recordio
+
+# every test in this module must reap its datapipe workers (see conftest)
+pytestmark = pytest.mark.usefixtures("no_datapipe_thread_leaks")
 
 
 def _write_recordio(path, payloads):
@@ -133,10 +137,55 @@ def test_batcher_drop_remainder_vs_pad():
     padded = list(datapipe.Batcher(iter(samples), batch_size=4,
                                    pad_to_batch=True))
     assert len(padded) == 3
-    assert [int(b["__valid__"]) for b in padded] == [4, 4, 2]
+    # __valid__ is a [batch_size] bool_ row mask (True = real row), usable
+    # directly as masked-loss weights on device
+    for b in padded:
+        assert b["__valid__"].dtype == np.bool_
+        assert b["__valid__"].shape == (4,)
+    assert [int(b["__valid__"].sum()) for b in padded] == [4, 4, 2]
+    np.testing.assert_array_equal(padded[2]["__valid__"],
+                                  [True, True, False, False])
     # pad rows repeat the last real sample; shape stays [batch_size, ...]
     np.testing.assert_array_equal(
         padded[2]["x"][:, 0], np.array([8, 9, 9, 9], np.float32))
+
+
+def test_pad_to_batch_mask_excludes_pad_rows_from_mean_loss():
+    """The point of the bool mask: a padded tail batch's mean-reduced loss
+    must equal the mean over REAL rows only, computed on device through the
+    executor (mask cast to 0/1 weights, masked sum / valid count)."""
+    samples = [{"x": np.full((1,), float(i), np.float32)} for i in range(6)]
+    pipe = (datapipe.DataPipe.from_reader(lambda: iter(samples))
+            .batch(4, drop_remainder=False, pad_to_batch=True)
+            .prefetch_to_device(place=fluid.CPUPlace(), chunk=1,
+                                capacity=2))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32")
+        valid = fluid.layers.data(name="__valid__", shape=[-1],
+                                  append_batch_size=False, dtype="bool")
+        w = fluid.layers.cast(valid, "float32")
+        per_row = fluid.layers.reduce_sum(x, dim=1)
+        masked_mean = fluid.layers.elementwise_div(
+            fluid.layers.reduce_sum(
+                fluid.layers.elementwise_mul(per_row, w)),
+            fluid.layers.reduce_sum(w))
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    means = []
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        while True:
+            try:
+                out, = exe.run(main, feed=pipe, fetch_list=[masked_mean])
+            except StopIteration:
+                break
+            means.extend(np.asarray(out).ravel().tolist())
+    pipe.close()
+    # batch 0: rows 0..3; batch 1: rows 4,5 + two pad repeats of row 5 —
+    # the naive unmasked mean would be (4+5+5+5)/4 = 4.75, not 4.5
+    np.testing.assert_allclose(means, [1.5, 4.5], rtol=1e-6)
 
 
 def test_batcher_ring_reuse_does_not_alias_emitted_batches():

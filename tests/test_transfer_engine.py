@@ -1,0 +1,335 @@
+"""Transfer engine: wire formats, donated staging, async fetch, reorder.
+
+The contract surface of paddle_tpu.datapipe.transfer + its executor
+plumbing: encode/decode roundtrips, on-device decode fused into the
+compiled step matching a host-normalized reference, wire bytes actually
+shrinking on the link (per-lane stats), donation markers reaching the
+compile cache (gated by FLAGS_donate_feed_buffers), FetchFuture ordering,
+and the feeder's reorder buffer under adversarially out-of-order transfer
+completion.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import datapipe
+from paddle_tpu.datapipe.transfer import (DONATE_KEY, WIRE_KEY, WireFormat,
+                                          WireSpec, pop_markers)
+
+# every test in this module must reap its datapipe workers (see conftest)
+pytestmark = pytest.mark.usefixtures("no_datapipe_thread_leaks")
+
+
+# -- WireFormat host/device roundtrips --------------------------------------
+def test_wireformat_uint8_passthrough_and_quantize():
+    fmt = WireFormat("uint8", compute_dtype="float32", scale=1.0 / 255.0)
+    u8 = np.arange(12, dtype=np.uint8)
+    assert fmt.encode(u8) is u8  # already in wire dtype: zero-copy
+
+    # a float source quantizes with the inverse of the on-device affine
+    f = np.array([0.0, 100 / 255.0, 1.0], np.float32)
+    enc = fmt.encode(f)
+    assert enc.dtype == np.uint8
+    np.testing.assert_array_equal(enc, [0, 100, 255])
+
+    import jax.numpy as jnp
+    dec = np.asarray(fmt.decode(jnp.asarray(enc)))
+    np.testing.assert_allclose(dec, f, rtol=1e-6)
+
+
+def test_wireformat_quantize_clips_out_of_range():
+    fmt = WireFormat("uint8", scale=1.0 / 255.0)
+    f = np.array([-0.5, 2.0], np.float32)  # outside [0, 1]
+    np.testing.assert_array_equal(fmt.encode(f), [0, 255])
+
+
+def test_wireformat_bfloat16_widens_to_var_dtype():
+    import jax.numpy as jnp
+
+    fmt = WireFormat("bfloat16")
+    f = np.linspace(-3, 3, 7, dtype=np.float32)
+    enc = fmt.encode(f)
+    assert str(enc.dtype) == "bfloat16"
+    dec = np.asarray(fmt.decode(jnp.asarray(enc), "float32"))
+    assert dec.dtype == np.float32
+    np.testing.assert_allclose(dec, f, atol=0.02)  # bf16 mantissa loss
+
+
+def test_wirespec_fingerprint_and_markers():
+    spec = WireSpec.uint8_images("img")
+    assert "img" in spec and "other" not in spec
+    assert spec.fingerprint() == WireSpec.uint8_images("img").fingerprint()
+    assert spec.fingerprint() != WireSpec.bfloat16("img").fingerprint()
+
+    chunk = {"img": np.zeros((2, 3), np.uint8), WIRE_KEY: spec,
+             DONATE_KEY: True}
+    feed, wire, donate = pop_markers(chunk)
+    assert wire is spec and donate is True
+    assert set(feed) == {"img"}
+    assert WIRE_KEY in chunk  # caller's dict untouched (shallow copy)
+
+    plain = {"img": np.zeros((2, 3), np.uint8)}
+    feed2, wire2, donate2 = pop_markers(plain)
+    assert feed2 is plain and wire2 is None and donate2 is False
+
+
+# -- fused on-device decode through the executor ----------------------------
+def _scale_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.reduce_mean(x, dim=1)
+    return main, startup, y
+
+
+def _pixel_reader(n=32):
+    rs = np.random.RandomState(7)
+    imgs = rs.randint(0, 256, size=(n, 4), dtype=np.uint8)
+    return imgs, lambda: ({"x": imgs[i]} for i in range(n))
+
+
+def test_uint8_wire_pipe_matches_host_normalized_reference():
+    """uint8 on the link, cast+/255 fused into the compiled scan: fetches
+    must match normalizing on the host in float32 before feeding."""
+    imgs, reader = _pixel_reader(32)
+    pipe = (datapipe.DataPipe.from_reader(reader)
+            .batch(4)
+            .prefetch_to_device(place=fluid.CPUPlace(), chunk=2, capacity=2,
+                                wire=WireSpec.uint8_images("x")))
+    assert pipe.wire_spec is not None
+
+    main, startup, y = _scale_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    s = fluid.Scope()
+    outs = []
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        while True:
+            try:
+                out, = exe.run(main, feed=pipe, fetch_list=[y])
+            except StopIteration:
+                break
+            outs.append(np.asarray(out))
+    pipe.close()
+    got = np.concatenate([o.reshape(-1) for o in outs])
+    want = (imgs.astype(np.float32) / 255.0).reshape(8, 4, 4).mean(2).ravel()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    # wire accounting: the link moved uint8, a quarter of the float bytes
+    st = pipe.stats()
+    f32_bytes = imgs.astype(np.float32).nbytes
+    assert st["transfer"]["bytes"] == f32_bytes // 4
+    lane_bytes = sum(st[k]["bytes"] for k in st if k.startswith("link"))
+    assert lane_bytes == st["transfer"]["bytes"]
+
+
+def test_wire_halves_link_bytes_vs_float32_source():
+    """Same float32 source shipped twice: the uint8-wire pipe must move
+    ~4x fewer bytes than the uncompressed pipe (per transfer stats)."""
+    rs = np.random.RandomState(3)
+    data = rs.uniform(0, 1, size=(16, 4)).astype(np.float32)
+
+    def bytes_through(wire):
+        pipe = (datapipe.DataPipe
+                .from_reader(lambda: ({"x": data[i]} for i in range(16)))
+                .batch(4)
+                .prefetch_to_device(place=fluid.CPUPlace(), chunk=2,
+                                    capacity=2, wire=wire))
+        for _ in pipe:
+            pass
+        pipe.close()
+        return pipe.stats()["transfer"]["bytes"]
+
+    plain = bytes_through(None)
+    wired = bytes_through(WireSpec.uint8_images("x"))
+    assert plain == data.nbytes
+    assert wired * 4 == plain
+
+
+# -- donation plumbing ------------------------------------------------------
+def test_donate_marker_reaches_compile_cache_and_flag_gates_it():
+    """Feeder-staged chunks ride DONATE_KEY; the executor folds it into the
+    compile-cache key (a donating and a non-donating executable must not
+    share an entry), and FLAGS_donate_feed_buffers=False turns it off."""
+    imgs, reader = _pixel_reader(16)
+
+    def run_pipe():
+        pipe = (datapipe.DataPipe.from_reader(reader)
+                .batch(4)
+                .prefetch_to_device(place=fluid.CPUPlace(), chunk=2,
+                                    capacity=2,
+                                    wire=WireSpec.uint8_images("x")))
+        main, startup, y = _scale_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        s = fluid.Scope()
+        with fluid.scope_guard(s):
+            exe.run(startup)
+            while True:
+                try:
+                    exe.run(main, feed=pipe, fetch_list=[y])
+                except StopIteration:
+                    break
+        pipe.close()
+        return exe
+
+    def donate_flags_in_cache(exe):
+        out = set()
+        for key in exe._compile_cache:  # startup entries carry no wire
+            kvs = dict(kv for kv in key if isinstance(kv, tuple)
+                       and len(kv) == 2
+                       and kv[0] in ("donate_feeds", "wire"))
+            if kvs.get("wire") is not None:
+                out.add(kvs.get("donate_feeds"))
+        return out
+
+    exe = run_pipe()
+    assert donate_flags_in_cache(exe) == {True}
+
+    fluid.flags.set("donate_feed_buffers", False)
+    try:
+        exe = run_pipe()
+        assert donate_flags_in_cache(exe) == {False}
+    finally:
+        fluid.flags.set("donate_feed_buffers", True)
+
+
+def test_stage_fn_chunks_never_marked_donatable():
+    """stage_fn chunks are callee-owned (it may hand the same dicts out
+    again), so the feeder must not mark them single-use; wire metadata
+    still rides on a COPY, leaving the callee's dict untouched."""
+    import jax
+
+    owned = {}
+
+    def stage(idx, stacked):
+        owned[idx] = {n: jax.device_put(a) for n, a in stacked.items()}
+        return owned[idx]
+
+    feeder = datapipe.AsyncDeviceFeeder(
+        lambda: ({"x": np.full((2,), i, np.float32)} for i in range(8)),
+        chunk=2, place=fluid.CPUPlace(), capacity=2, transfer_threads=1,
+        stage_fn=stage, wire=WireSpec.bfloat16("x"))
+    staged = list(feeder)
+    assert len(staged) == 4  # 8 samples, K=2 per chunk
+    for ch in staged:
+        assert WIRE_KEY in ch and DONATE_KEY not in ch
+    for d in owned.values():  # callee's dicts never grew metadata keys
+        assert set(d) == {"x"}
+
+
+# -- async fetch ------------------------------------------------------------
+def test_async_fetch_futures_match_sync_results():
+    imgs, reader = _pixel_reader(32)
+
+    def results(async_fetch):
+        pipe = (datapipe.DataPipe.from_reader(reader)
+                .batch(4)
+                .prefetch_to_device(place=fluid.CPUPlace(), chunk=2,
+                                    capacity=2,
+                                    wire=WireSpec.uint8_images("x")))
+        main, startup, y = _scale_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        s = fluid.Scope()
+        outs, futs = [], []
+        with fluid.scope_guard(s):
+            exe.run(startup)
+            while True:
+                try:
+                    out, = exe.run(main, feed=pipe, fetch_list=[y],
+                                   async_fetch=async_fetch)
+                except StopIteration:
+                    break
+                (futs if async_fetch else outs).append(out)
+        # depth-1 fencing idiom: resolve AFTER the next dispatch went out
+        for f in futs:
+            assert isinstance(f, fluid.executor.FetchFuture)
+            outs.append(f.result())
+            assert f.done()
+            assert f.result() is outs[-1]  # host value cached
+        pipe.close()
+        return np.concatenate([np.asarray(o).reshape(-1) for o in outs])
+
+    np.testing.assert_allclose(results(False), results(True), rtol=1e-6)
+
+
+# -- reorder buffer under out-of-order completion ---------------------------
+def test_reorder_buffer_emits_in_order_under_skewed_transfer_delay():
+    """3 transfer threads with adversarial per-chunk delays (earlier chunks
+    finish LAST): emission must stay in chunk order, every chunk exactly
+    once — the reorder buffer, not completion order."""
+    import jax
+
+    completed = []
+
+    def slow_stage(idx, stacked):
+        time.sleep([0.15, 0.1, 0.05, 0.0][idx % 4])
+        completed.append(idx)
+        return {n: jax.device_put(a) for n, a in stacked.items()}
+
+    feeder = datapipe.AsyncDeviceFeeder(
+        lambda: ({"x": np.full((2,), i, np.float32)} for i in range(24)),
+        chunk=2, place=fluid.CPUPlace(), capacity=4, transfer_threads=3,
+        stage_fn=slow_stage)
+    got = [float(np.asarray(ch["x"])[0, 0]) for ch in feeder]
+    assert got == [2.0 * i for i in range(12)], got
+    assert sorted(completed) == list(range(12))
+    assert completed != list(range(12))  # the skew really reordered work
+
+
+def test_reorder_early_close_releases_tickets_and_threads():
+    """Close mid-stream while chunks are in flight out of order: workers
+    must exit (no wedged ticket waiters) and a FRESH iteration of the same
+    feeder must deliver the full stream — nothing leaked into shared
+    state."""
+    import jax
+
+    def slow_stage(idx, stacked):
+        time.sleep(0.05 if idx % 2 == 0 else 0.0)
+        return {n: jax.device_put(a) for n, a in stacked.items()}
+
+    def src():
+        return ({"x": np.full((2,), i, np.float32)} for i in range(16))
+
+    feeder = datapipe.AsyncDeviceFeeder(
+        src, chunk=2, place=fluid.CPUPlace(), capacity=3,
+        transfer_threads=2, stage_fn=slow_stage)
+
+    it = iter(feeder)
+    next(it)
+    next(it)
+    it.close()  # 2 of 8 chunks consumed; the rest in flight
+
+    base = threading.active_count()
+    deadline = time.time() + 5.0
+    while time.time() < deadline and any(
+            t.name.startswith("datapipe-feed-") for t in
+            threading.enumerate()):
+        time.sleep(0.02)
+    assert not any(t.name.startswith("datapipe-feed-")
+                   for t in threading.enumerate())
+
+    # a fresh pass sees the whole stream, in order
+    vals = [float(np.asarray(ch["x"])[0, 0]) for ch in feeder]
+    assert vals == [2.0 * i for i in range(8)], vals
+    assert threading.active_count() <= base
+
+
+# -- deprecation shim -------------------------------------------------------
+def test_device_chunk_feeder_warns_exactly_once_per_process():
+    import warnings
+
+    import paddle_tpu.pipeline as pipeline_mod
+
+    pipeline_mod._deprecation_warned = False  # fresh process state
+    reader = lambda: iter(())  # noqa: E731
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        fluid.DeviceChunkFeeder(reader, chunk=2)
+        fluid.DeviceChunkFeeder(reader, chunk=2)
+    dep = [i for i in w if issubclass(i.category, DeprecationWarning)
+           and "DeviceChunkFeeder" in str(i.message)]
+    assert len(dep) == 1, [str(i.message) for i in w]
