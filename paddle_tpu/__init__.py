@@ -97,6 +97,7 @@ from . import pipeline
 from .pipeline import DeviceChunkFeeder
 from . import datapipe
 from .datapipe import DataPipe, AsyncDeviceFeeder
+from . import monitor
 from . import dataset
 from . import parallel
 from .minibatch import batch
@@ -121,5 +122,5 @@ __all__ = [
     "InferenceTranspiler", "memory_optimize", "release_memory",
     "reader", "dataset", "batch", "unique_name", "parallel", "flags",
     "concurrency", "pipeline", "DeviceChunkFeeder", "datapipe", "DataPipe",
-    "AsyncDeviceFeeder",
+    "AsyncDeviceFeeder", "monitor",
 ]

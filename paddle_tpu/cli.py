@@ -12,6 +12,9 @@ Commands:
                      (--role trainer|pserver --trainers N --trainer-id I
                       --pservers host:port,...) — the same variables
                      Trainer()'s cluster bootstrap reads.
+  monitor JOURNAL    summarize a FLAGS_monitor_journal step journal
+                     (step/phase timings, compile-cache hit rate, replica
+                     skew); --json emits the summary as JSON.
 """
 
 import argparse
@@ -42,6 +45,24 @@ def _cmd_flags(args):
     return 0
 
 
+def _cmd_monitor(args):
+    from .monitor import format_summary, read_journal, summarize_journal
+
+    try:
+        records = read_journal(args.journal)
+    except OSError as e:
+        print(f"cannot read journal: {e}", file=sys.stderr)
+        return 1
+    summary = summarize_journal(records)
+    if args.json:
+        import json
+
+        print(json.dumps(summary, indent=2))
+    else:
+        print(format_summary(summary))
+    return 0
+
+
 def _cmd_train(args):
     env = dict(os.environ)
     env["PADDLE_TRAINING_ROLE"] = args.role.upper()
@@ -62,6 +83,12 @@ def main(argv=None):
     sub.add_parser("version", help="print version and backend info")
     sub.add_parser("flags", help="list runtime flags")
 
+    m = sub.add_parser("monitor", help="summarize a step-journal file "
+                                       "(FLAGS_monitor_journal)")
+    m.add_argument("journal", help="path of the JSONL step journal")
+    m.add_argument("--json", action="store_true",
+                   help="emit the summary as JSON instead of a table")
+
     t = sub.add_parser("train", help="launch a training script with "
                                      "cluster environment")
     t.add_argument("--role", default="trainer",
@@ -80,6 +107,8 @@ def main(argv=None):
         return _cmd_version(args)
     if args.command == "flags":
         return _cmd_flags(args)
+    if args.command == "monitor":
+        return _cmd_monitor(args)
     if args.command == "train":
         return _cmd_train(args)
     parser.error(f"unknown command {args.command}")

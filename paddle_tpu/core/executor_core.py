@@ -349,7 +349,8 @@ def build_step_fn(program, fetch_names, state_out_names, is_test=False):
     return step
 
 
-def compile_step_fn(step, donate_state=True, donate_feeds=False):
+def compile_step_fn(step, donate_state=True, donate_feeds=False,
+                    probe=None):
     """jit the step. donate_state aliases mut_state so parameters update in
     place; donate_feeds ALSO donates the feeds argument — correct only for
     single-use staged chunks (datapipe transfer engine marks them with
@@ -359,15 +360,31 @@ def compile_step_fn(step, donate_state=True, donate_feeds=False):
     about every non-aliasable donated buffer; calls run with that warning
     suppressed (lowering happens on first call, so the jit() site can't
     scope it) because early reuse of the staging memory — not output
-    aliasing — is the point of donating feeds."""
+    aliasing — is the point of donating feeds.
+
+    probe: optional callable(jitted, args) invoked once immediately before
+    the FIRST execution — the only point where the jitted fn and live
+    (not-yet-donated) example args coexist, which is what
+    monitor.compile_probe needs to lower for HLO cost analysis. Probe
+    failures never fail the step."""
     donate = (0,) if donate_state else ()
-    if not donate_feeds:
+    if not donate_feeds and probe is None:
         return jax.jit(step, donate_argnums=donate)
-    compiled = jax.jit(step, donate_argnums=donate + (2,))
+    compiled = jax.jit(
+        step, donate_argnums=donate + ((2,) if donate_feeds else ()))
+    probed = [probe is None]
 
     def call(*args):
         import warnings
 
+        if not probed[0]:
+            probed[0] = True
+            try:
+                probe(compiled, args)
+            except Exception:
+                pass
+        if not donate_feeds:
+            return compiled(*args)
         with warnings.catch_warnings():
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")

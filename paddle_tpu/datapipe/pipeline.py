@@ -230,3 +230,9 @@ class DataPipe:
         """{stage: {items, bytes, busy_s, wait_in_s, wait_out_s, ...},
         'fractions': {...}} — see datapipe.stats.PipeStats.snapshot."""
         return self._stats.snapshot()
+
+    def stats_delta(self):
+        """Per-stage counter deltas since the previous stats_delta() call
+        (what ONE step consumed) — merged into the monitor's step journal
+        when the executor pulls from this pipe."""
+        return self._stats.delta()

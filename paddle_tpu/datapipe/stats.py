@@ -57,9 +57,14 @@ class StageStats:
         with self._lock:
             self._depth_sum += int(depth)
             self._depth_n += 1
-        from .. import profiler
+        from .. import monitor, profiler
 
         profiler.record_counter(f"datapipe/{self.name}/qdepth", depth)
+        if monitor.enabled():
+            monitor.registry().gauge(
+                "datapipe_queue_depth",
+                help="sampled stage queue depth",
+                stage=self.name).set(depth)
 
     def span(self):
         """Context manager timing one unit of stage work; also emits a
@@ -96,6 +101,7 @@ class PipeStats:
     def __init__(self):
         self._stages = []  # wiring order
         self._lock = threading.Lock()
+        self._delta_base = {}  # stage name -> counters at last delta()
 
     def stage(self, name):
         with self._lock:
@@ -122,4 +128,24 @@ class PipeStats:
                 s.name: round(out[s.name]["busy_s"] / total_busy, 4)
                 for s in stages
             }
+        return out
+
+    _DELTA_KEYS = ("items", "bytes", "busy_s", "wait_in_s", "wait_out_s")
+
+    def delta(self):
+        """Per-stage counter DELTAS since the previous delta() call — what
+        one executor step consumed/waited, not lifetime totals (the
+        monitor's step journal merges this, one record per step)."""
+        with self._lock:
+            stages = list(self._stages)
+        out = {}
+        with self._lock:
+            for s in stages:
+                snap = s.snapshot()
+                base = self._delta_base.get(s.name, {})
+                d = {k: round(snap.get(k, 0) - base.get(k, 0), 6)
+                     for k in self._DELTA_KEYS}
+                self._delta_base[s.name] = {
+                    k: snap.get(k, 0) for k in self._DELTA_KEYS}
+                out[s.name] = d
         return out

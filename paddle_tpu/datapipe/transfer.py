@@ -160,6 +160,11 @@ class WireSpec:
         return tuple(sorted(
             (n, f.fingerprint()) for n, f in self._formats.items()))
 
+    def describe(self):
+        """{feed_name: wire-format repr} — the journal-friendly rendering
+        of what each covered feed looks like on the wire."""
+        return {n: repr(f) for n, f in sorted(self._formats.items())}
+
     def __repr__(self):
         return f"WireSpec({self._formats!r})"
 

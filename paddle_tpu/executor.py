@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 from . import amp
 from . import flags
+from . import monitor
 from .core import executor_core, registry
 from .core.framework import Program, Variable, default_main_program
 from .core.lod_tensor import LoDTensor
@@ -305,10 +306,18 @@ class Executor:
             program = default_main_program()
         if scope is None:
             scope = global_scope()
-        if hasattr(feed, "next_feed"):  # datapipe.DataPipe (duck-typed)
+        # the ONE per-step monitor flag check; mon stays None when off and
+        # every telemetry site below is gated on `mon is not None`
+        mon = monitor.step_begin("executor") if monitor.enabled() else None
+        pipe = feed if hasattr(feed, "next_feed") else None
+        if pipe is not None:  # datapipe.DataPipe (duck-typed)
             if iters is None:
-                iters = getattr(feed, "feed_iters", None)
-            feed = feed.next_feed()
+                iters = getattr(pipe, "feed_iters", None)
+            if mon is not None:
+                with mon.timed("feed_wait"):
+                    feed = pipe.next_feed()
+            else:
+                feed = pipe.next_feed()
         if isinstance(feed, (list, tuple)) and iters is None:
             iters = len(feed)  # length consistency checked in the helper
         feed = feed if feed is not None else {}
@@ -339,18 +348,26 @@ class Executor:
                         "step-by-step)")
                 outs = self._run_compiled_multi(
                     program, scope, feed, fetch_names, use_program_cache,
-                    iters, wire=wire, donate_feeds=donate_feeds)
+                    iters, wire=wire, donate_feeds=donate_feeds, mon=mon)
             elif _program_has_host_ops(program):
+                if mon is not None:
+                    mon.kind = "executor_eager"
                 outs = self._run_eager(program, scope, feed, fetch_names,
-                                       wire=wire)
+                                       wire=wire, mon=mon)
             else:
                 outs = self._run_compiled(
                     program, scope, feed, fetch_names, use_program_cache,
-                    wire=wire, donate_feeds=donate_feeds)
+                    wire=wire, donate_feeds=donate_feeds, mon=mon)
         if async_fetch:
-            return [FetchFuture(o) for o in outs]
-        if return_numpy:
-            return [as_numpy(o) for o in outs]
+            outs = [FetchFuture(o) for o in outs]
+        elif return_numpy:
+            if mon is not None:
+                with mon.timed("fetch_readback"):
+                    outs = [as_numpy(o) for o in outs]
+            else:
+                outs = [as_numpy(o) for o in outs]
+        if mon is not None:
+            monitor.step_end(mon, iters=iters, datapipe=pipe)
         return outs
 
     # ------------------------------------------------------------------
@@ -392,9 +409,26 @@ class Executor:
         return jax.random.fold_in(jax.random.PRNGKey(program.random_seed), step)
 
     # ------------------------------------------------------------------
+    def _cache_store(self, cache_key, entry, mon=None):
+        """Insert a compile-cache entry, evicting the oldest entries when
+        FLAGS_compile_cache_cap bounds the cache (insertion order — the
+        dict preserves it). Evictions are a recompile-churn signal, so
+        each one is counted in the monitor registry."""
+        cap = flags.get("compile_cache_cap")
+        if cap and cap > 0:
+            while len(self._compile_cache) >= cap:
+                self._compile_cache.pop(next(iter(self._compile_cache)))
+                if mon is not None:
+                    monitor.cache_evicted(mon.kind)
+        self._compile_cache[cache_key] = entry
+
     def _run_compiled(self, program, scope, feed, fetch_names, use_cache,
-                      wire=None, donate_feeds=False):
-        feed_vals = self._feed_values(program, feed, wire=wire)
+                      wire=None, donate_feeds=False, mon=None):
+        if mon is not None:
+            with mon.timed("feed_encode"):
+                feed_vals = self._feed_values(program, feed, wire=wire)
+        else:
+            feed_vals = self._feed_values(program, feed, wire=wire)
         state_names, state_out_names = executor_core.collect_state_names(program, scope)
         if flags.get("debug_nans"):
             donate_feeds = False  # re-run needs the inputs (see below)
@@ -411,21 +445,30 @@ class Executor:
             ("donate_feeds", donate_feeds),
         )
         entry = self._compile_cache.get(cache_key) if use_cache else None
+        fp = monitor.fingerprint_of(cache_key) if mon is not None else None
+        if mon is not None:
+            mon.mark_cache(entry is not None, fingerprint=fp)
+        build_s = 0.0
+        was_miss = entry is None
         if entry is None:
+            tb = time.perf_counter()
             step = executor_core.build_step_fn(program, fetch_names, state_out_names)
             if wire is not None:
                 step = wire.wrap_step(
                     step, var_dtypes=self._wire_var_dtypes(program, wire))
+            probe = monitor.compile_probe(fp) \
+                if mon is not None and flags.get("monitor_hlo_cost") else None
             # under debug_nans the trap fires INSIDE compiled() before the
             # scope write-back; donated buffers would already be deleted,
             # wrecking both the scope and jax's op-by-op re-run — so trade
             # the in-place update away while the sanitizer is on
             compiled = executor_core.compile_step_fn(
                 step, donate_state=not flags.get("debug_nans"),
-                donate_feeds=donate_feeds)
+                donate_feeds=donate_feeds, probe=probe)
+            build_s = time.perf_counter() - tb
             entry = (compiled, state_names, state_out_names)
             if use_cache:
-                self._compile_cache[cache_key] = entry
+                self._cache_store(cache_key, entry, mon=mon)
         compiled, state_names, state_out_names = entry
 
         mut_state = {}
@@ -438,7 +481,17 @@ class Executor:
             (mut_state if n in out_set else const_state)[n] = v
         rng = self._rng_for(program)
         t0 = time.perf_counter() if flags.get("benchmark") else None
+        tc = time.perf_counter() if mon is not None else None
         fetches, new_mut = compiled(mut_state, const_state, feed_vals, rng)
+        if mon is not None:
+            call_s = time.perf_counter() - tc
+            if was_miss:
+                # under async dispatch the FIRST call includes XLA compile;
+                # attribute trace + compile to the "compile" phase
+                mon.phase("compile", build_s + call_s)
+                monitor.record_compile(fp, wall_s=build_s + call_s)
+            else:
+                mon.phase("dispatch", call_s)  # enqueue time (async)
         # write back BEFORE any nan check can raise: mut_state was donated,
         # so skipping this would leave the scope holding deleted buffers
         for n, v in new_mut.items():
@@ -462,7 +515,16 @@ class Executor:
                     mem = f" peak_hbm={peak / 1e6:.1f}MB"
             except Exception:
                 pass
-            print(f"[paddle_tpu] run: {(time.perf_counter() - t0) * 1000:.3f}"
+            # the timing is a metric first, a log line second: record the
+            # fenced wall time in the monitor registry and print THAT value
+            reg = monitor.registry()
+            g = reg.gauge("benchmark_run_ms",
+                          help="FLAGS_benchmark fenced wall time per run")
+            g.set((time.perf_counter() - t0) * 1000.0)
+            reg.histogram("benchmark_run_ms_hist",
+                          help="FLAGS_benchmark fenced wall time "
+                               "distribution").observe(g.value)
+            print(f"[paddle_tpu] run: {g.value:.3f}"
                   f" ms (fetches={len(fetches)}){mem}", file=sys.stderr)
         if flags.get("check_nan_inf"):
             # per-op blame isn't available inside one XLA computation; check
@@ -476,8 +538,13 @@ class Executor:
         return stack_multi_step_feeds(program, feed, iters, wire=wire)
 
     def _run_compiled_multi(self, program, scope, feed, fetch_names,
-                            use_cache, iters, wire=None, donate_feeds=False):
-        feed_vals = self._stack_feeds(program, feed, iters, wire=wire)
+                            use_cache, iters, wire=None, donate_feeds=False,
+                            mon=None):
+        if mon is not None:
+            with mon.timed("feed_encode"):
+                feed_vals = self._stack_feeds(program, feed, iters, wire=wire)
+        else:
+            feed_vals = self._stack_feeds(program, feed, iters, wire=wire)
         state_names, state_out_names = executor_core.collect_state_names(
             program, scope)
         missing = [n for n in state_out_names if not scope.has_var(n)]
@@ -514,7 +581,13 @@ class Executor:
             (mut_state if n in out_set else const_state)[n] = v
 
         entry = self._compile_cache.get(cache_key) if use_cache else None
+        fp = monitor.fingerprint_of(cache_key) if mon is not None else None
+        if mon is not None:
+            mon.mark_cache(entry is not None, fingerprint=fp)
+        build_s = 0.0
+        was_miss = entry is None
         if entry is None:
+            tb = time.perf_counter()
             step = executor_core.build_step_fn(
                 program, fetch_names, state_out_names)
             if wire is not None:
@@ -535,19 +608,22 @@ class Executor:
                 else:
                     plan = None
             multi = executor_core.build_multi_step_fn(step, iters, ema=ema)
+            probe = monitor.compile_probe(fp) \
+                if mon is not None and flags.get("monitor_hlo_cost") else None
             compiled = executor_core.compile_step_fn(
                 multi, donate_state=not flags.get("debug_nans"),
-                donate_feeds=donate_feeds)
+                donate_feeds=donate_feeds, probe=probe)
             unpackers = {}
             if plan is not None:
                 for g in plan.groups:
                     unpackers[g["key"]] = jax.jit(
                         lambda P, _g=g:
                         executor_core.PackPlan.group_views(_g, P))
+            build_s = time.perf_counter() - tb
             entry = (compiled, state_names, state_out_names, plan,
                      unpackers, {})
             if use_cache:
-                self._compile_cache[cache_key] = entry
+                self._cache_store(cache_key, entry, mon=mon)
         compiled, state_names, state_out_names, plan, unpackers, memo = entry
 
         if plan is not None:
@@ -587,7 +663,15 @@ class Executor:
         # step0 rides as a traced array to keep the compile cache hot
         rng = (jax.random.PRNGKey(program.random_seed),
                jnp.asarray(step0, jnp.int32))
+        tc = time.perf_counter() if mon is not None else None
         fetches, new_mut = compiled(mut_state, const_state, feed_vals, rng)
+        if mon is not None:
+            call_s = time.perf_counter() - tc
+            if was_miss:  # first call compiles under async dispatch
+                mon.phase("compile", build_s + call_s)
+                monitor.record_compile(fp, wall_s=build_s + call_s)
+            else:
+                mon.phase("dispatch", call_s)
         if plan is not None:
             plain = {n: v for n, v in new_mut.items()
                      if not n.startswith("__packed__")}
@@ -640,9 +724,15 @@ class Executor:
                     scope.var(n)
                     scope.set_var(n, env[n])
 
-    def _run_eager(self, program, scope, feed, fetch_names, wire=None):
-        feed_vals = self._feed_values(program, feed, wire=wire,
-                                      decode_eager=True)
+    def _run_eager(self, program, scope, feed, fetch_names, wire=None,
+                   mon=None):
+        if mon is not None:
+            with mon.timed("feed_encode"):
+                feed_vals = self._feed_values(program, feed, wire=wire,
+                                              decode_eager=True)
+        else:
+            feed_vals = self._feed_values(program, feed, wire=wire,
+                                          decode_eager=True)
         env = {}
         touched = set()
         for b in program.blocks:
@@ -665,7 +755,11 @@ class Executor:
             fetch_sink=fetch_sink,
             place=self.place,
         )
-        executor_core.run_ops(program.global_block().ops, env, ctx)
+        if mon is not None:
+            with mon.timed("dispatch"):
+                executor_core.run_ops(program.global_block().ops, env, ctx)
+        else:
+            executor_core.run_ops(program.global_block().ops, env, ctx)
         persistable = {
             n
             for blk in program.blocks

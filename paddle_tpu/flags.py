@@ -134,6 +134,21 @@ define("pack_small_state", bool, False,
        "the conv fusions — the step is scheduler-bound, not launch-bound "
        "(docs/perf_r05.md). Default OFF; the mechanism stays for "
        "topologies with far more small state.")
+define("monitor", bool, True,
+       "Step-level training telemetry (paddle_tpu.monitor): per-step phase "
+       "breakdown, compile-cache hit/miss accounting, datapipe merge, "
+       "replica-skew gauges. Default ON — when set to 0 the per-step cost "
+       "is a single flag check (asserted by tests/test_monitor.py).")
+define("monitor_journal", str, "",
+       "Path of the JSONL step journal (one self-contained record per "
+       "executor step; schema in paddle_tpu/monitor/journal.py). Empty = "
+       "no journal. Render with `paddle_tpu monitor <path>`.")
+define("compile_cache_cap", int, 0,
+       "Maximum live entries per executor compile cache; 0 = unbounded "
+       "(the reference behaviour). When the cap is hit the oldest entry "
+       "is evicted (insertion order) and counted in "
+       "monitor compile_cache_evictions_total — visibility for workloads "
+       "that churn program shapes and silently re-compile.")
 define("fuse_optimizer_ops", bool, False,
        "Batch identical small-parameter optimizer updates (sgd/momentum) "
        "into one kernel call over concatenated flats. Default OFF: on the "

@@ -1,0 +1,169 @@
+"""JSONL step journal: one line per executor step, durable observability.
+
+The registry (registry.py) answers "what is happening now"; the journal
+answers "what happened at step N" after the run is over — each step_end
+appends one self-contained JSON object, so a crashed or remote run leaves
+a parseable artifact. `paddle_tpu monitor <journal>` (cli.py) renders the
+summary; read_journal/summarize_journal are the library surface.
+
+Schema (one object per line; optional fields omitted when absent):
+  ts            wall-clock seconds (time.time) at step end
+  step          process-wide monotone step index
+  kind          "executor" | "executor_eager" | "parallel_executor"
+  iters         K of a multi-step scan dispatch (null for single step)
+  total_ms      wall time of the whole run() call
+  phases_ms     {"feed_encode": .., "compile": .., "dispatch": ..,
+                 "fetch_readback": ..}  (phases that occurred this step)
+  cache         "hit" | "miss"  (compile-cache outcome)
+  fingerprint   8-hex id of the compile-cache key (joins compile_info)
+  datapipe      per-stage delta stats when the step pulled from a DataPipe
+  wire          {feed: wire-format repr} when a WireSpec rode the chunk
+  replica_ms    per-replica completion times (parallel mesh, skew-flagged)
+  replica_ids   device ids aligned with replica_ms
+  skew          {"replicas", "max_ms", "median_ms", "max_over_median",
+                 "slowest"}
+"""
+
+import json
+import threading
+
+__all__ = ["JournalWriter", "read_journal", "summarize_journal",
+           "format_summary"]
+
+
+def _default(o):
+    """Journal records should never fail to serialize: numpy scalars and
+    arrays degrade to python numbers/lists, anything else to repr."""
+    try:
+        import numpy as np
+
+        if isinstance(o, np.integer):
+            return int(o)
+        if isinstance(o, np.floating):
+            return float(o)
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+    except Exception:
+        pass
+    return repr(o)
+
+
+class JournalWriter:
+    """Append-only JSONL writer, flushed per record (a crash loses at most
+    the in-flight line)."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a")
+
+    def write(self, record):
+        line = json.dumps(record, default=_default)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+def read_journal(path):
+    """Parse a JSONL journal -> list of step records (skips blank lines;
+    a torn final line — crash mid-write — is dropped, not fatal)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return records
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, max(0, int(round(
+        q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def summarize_journal(records):
+    """Aggregate step records -> summary dict (cli.py renders it)."""
+    totals = sorted(float(r["total_ms"]) for r in records
+                    if r.get("total_ms") is not None)
+    phases = {}
+    for r in records:
+        for name, ms in (r.get("phases_ms") or {}).items():
+            phases.setdefault(name, []).append(float(ms))
+    cache = {"hit": 0, "miss": 0}
+    for r in records:
+        c = r.get("cache")
+        if c in cache:
+            cache[c] += 1
+    skews = [r["skew"]["max_over_median"] for r in records
+             if isinstance(r.get("skew"), dict)
+             and r["skew"].get("max_over_median") is not None]
+    slowest = {}
+    for r in records:
+        if isinstance(r.get("skew"), dict) and "slowest" in r["skew"]:
+            s = r["skew"]["slowest"]
+            slowest[s] = slowest.get(s, 0) + 1
+    out = {
+        "steps": len(records),
+        "kinds": sorted({r.get("kind") for r in records if r.get("kind")}),
+        "step_ms": {
+            "mean": (sum(totals) / len(totals)) if totals else None,
+            "p50": _percentile(totals, 50),
+            "p95": _percentile(totals, 95),
+            "max": totals[-1] if totals else None,
+        },
+        "phases_ms_mean": {
+            n: sum(v) / len(v) for n, v in sorted(phases.items())
+        },
+        "cache": cache,
+    }
+    if skews:
+        out["skew_max_over_median"] = {
+            "mean": sum(skews) / len(skews),
+            "max": max(skews),
+        }
+    if slowest:
+        out["slowest_replica_counts"] = slowest
+    return out
+
+
+def format_summary(summary):
+    """Human-readable rendering of summarize_journal's dict."""
+    lines = [f"steps: {summary['steps']}  "
+             f"kinds: {', '.join(summary['kinds']) or '-'}"]
+    sm = summary["step_ms"]
+    if sm["mean"] is not None:
+        lines.append(
+            f"step_ms: mean={sm['mean']:.3f} p50={sm['p50']:.3f} "
+            f"p95={sm['p95']:.3f} max={sm['max']:.3f}")
+    if summary["phases_ms_mean"]:
+        total = sum(summary["phases_ms_mean"].values()) or 1.0
+        lines.append(f"{'phase':<16}{'mean_ms':>12}{'share':>8}")
+        for n, v in sorted(summary["phases_ms_mean"].items(),
+                           key=lambda kv: -kv[1]):
+            lines.append(f"{n:<16}{v:>12.3f}{v / total:>8.1%}")
+    c = summary["cache"]
+    lines.append(f"compile cache: {c['hit']} hits / {c['miss']} misses")
+    if "skew_max_over_median" in summary:
+        s = summary["skew_max_over_median"]
+        lines.append(
+            f"replica skew (max/median): mean={s['mean']:.3f} "
+            f"max={s['max']:.3f}")
+    if "slowest_replica_counts" in summary:
+        top = sorted(summary["slowest_replica_counts"].items(),
+                     key=lambda kv: -kv[1])
+        lines.append("slowest replica: " + ", ".join(
+            f"{r} x{n}" for r, n in top[:4]))
+    return "\n".join(lines)

@@ -1,0 +1,76 @@
+"""Per-replica step-time skew on the parallel mesh.
+
+A multichip run that reports one aggregate rate hides stragglers: one slow
+replica gates every collective, so the mesh runs at the slowest replica's
+pace (the observation motivating cross-replica weight-update sharding,
+arxiv 2004.13336 — skew is the signal for where sharding pays off).
+
+measure_replica_ms fences each replica's shard of a step output IN DEVICE
+ORDER and stamps elapsed time per replica. Sequential fencing makes each
+entry an upper bound (replica i's stamp includes waiting on replicas
+< i that finished later), but the slowest replica still dominates its own
+stamp, which is what the max/median ratio needs. Fencing synchronizes the
+dispatch queue, so ParallelExecutor only measures under
+FLAGS_monitor_replica_skew.
+
+replica_skew is the pure math (max/median ratio + slowest id) — unit-
+testable on synthetic timing sets.
+"""
+
+import time
+
+__all__ = ["replica_skew", "measure_replica_ms"]
+
+
+def replica_skew(times_ms, ids=None):
+    """Skew summary of one step's per-replica completion times.
+
+    times_ms: per-replica milliseconds; ids: optional aligned replica ids
+    (device ids). Returns {"replicas", "max_ms", "median_ms",
+    "max_over_median", "slowest"} — slowest is the id (or index) of the
+    worst replica; max_over_median is None when the median is zero."""
+    times = [float(t) for t in times_ms]
+    if not times:
+        raise ValueError("times_ms is empty")
+    n = len(times)
+    srt = sorted(times)
+    median = (srt[n // 2] if n % 2 == 1
+              else 0.5 * (srt[n // 2 - 1] + srt[n // 2]))
+    worst = max(range(n), key=lambda i: times[i])
+    return {
+        "replicas": n,
+        "max_ms": round(times[worst], 6),
+        "median_ms": round(median, 6),
+        "max_over_median": (round(times[worst] / median, 6)
+                            if median > 0 else None),
+        "slowest": (ids[worst] if ids is not None else worst),
+    }
+
+
+def measure_replica_ms(value, t0):
+    """Per-replica completion stamps for one step output.
+
+    value: a step output (jax.Array; SeqTensor unwraps to .data) whose
+    addressable shards span the mesh's local replicas; t0: perf_counter at
+    dispatch. Returns (times_ms, device_ids) ordered by device id, or None
+    when the value has no per-device shards (plain numpy, single device
+    without sharding info)."""
+    import jax
+
+    leaf = getattr(value, "data", value) if not hasattr(value, "dtype") \
+        else value
+    if hasattr(leaf, "data") and not hasattr(leaf, "addressable_shards"):
+        leaf = leaf.data  # SeqTensor
+    shards = getattr(leaf, "addressable_shards", None)
+    if not shards or len(shards) < 2:
+        return None
+    try:
+        ordered = sorted(shards, key=lambda s: s.device.id)
+    except Exception:
+        ordered = list(shards)
+    times, ids = [], []
+    for sh in ordered:
+        jax.block_until_ready(sh.data)
+        times.append((time.perf_counter() - t0) * 1000.0)
+        ids.append(int(getattr(sh.device, "id", len(ids))))
+    return times, ids

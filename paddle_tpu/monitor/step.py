@@ -1,0 +1,297 @@
+"""Step-level monitor: phase records, compile-cache visibility, journal.
+
+The executors call exactly three things on the hot path:
+
+    mon = monitor.step_begin("executor") if monitor.enabled() else None
+    ...
+    mon.phase("dispatch", seconds)          # guarded by `mon is not None`
+    ...
+    monitor.step_end(mon, iters=K, datapipe=pipe)
+
+step_begin is gated on ONE flag check; with FLAGS_monitor=0 nothing else
+runs — no allocation, no registry mutation, no journal I/O (asserted by
+tests/test_monitor.py). step_end folds the record into the process
+registry (counters/gauges/histograms), captures it as last_step(), and
+appends one JSONL line when FLAGS_monitor_journal names a path.
+
+Compile-cache visibility: executors mark every cache lookup
+(mark_cache), and on a miss hand compile_probe() to
+executor_core.compile_step_fn — the probe lowers the jitted step once,
+immediately before its first execution (inputs are still alive there;
+after the call donated buffers are deleted), and records the HLO cost
+analysis (FLOPs + bytes accessed) plus compile wall time per cache-key
+fingerprint. bench.py turns those FLOPs into MFU (see mfu.py).
+"""
+
+import contextlib
+import threading
+import time
+
+from .. import flags
+from .journal import JournalWriter
+from .registry import MetricsRegistry
+from .skew import replica_skew
+
+__all__ = ["StepRecord", "enabled", "registry", "exposition", "reset",
+           "step_begin", "step_end", "last_step", "compile_info",
+           "record_compile", "compile_probe", "fingerprint_of",
+           "cache_evicted"]
+
+flags.define(
+    "monitor_hlo_cost", bool, False,
+    "On every compile-cache miss, lower the step once more and record the "
+    "HLO cost analysis (FLOPs + bytes accessed) per program fingerprint — "
+    "the model-FLOPs source for MFU accounting (bench.py). Off by "
+    "default: the extra lowering roughly doubles trace time per compile.")
+flags.define(
+    "monitor_replica_skew", bool, False,
+    "Measure per-replica step-completion times on the ParallelExecutor "
+    "mesh each step (max/median skew, slowest replica). Fences the "
+    "dispatch queue per step — a straggler-hunting mode, not a "
+    "production default.")
+
+_registry = MetricsRegistry()
+_lock = threading.Lock()
+_state = {
+    "steps": 0,          # process-wide step index
+    "last": None,        # last completed step record (dict)
+    "journal": None,     # open JournalWriter
+    "journal_path": None,
+    "compile_info": {},  # fingerprint -> {wall_s, flops, bytes_accessed}
+}
+
+
+def enabled():
+    """THE per-step flag check: everything else is gated on its result."""
+    return bool(flags.get("monitor"))
+
+
+def registry():
+    return _registry
+
+
+def exposition():
+    """Prometheus-style text exposition of the process registry."""
+    return _registry.exposition()
+
+
+def reset():
+    """Fresh telemetry session: drop metrics, step records, compile info,
+    and close any open journal (tests / long-lived processes)."""
+    with _lock:
+        _state["steps"] = 0
+        _state["last"] = None
+        _state["compile_info"] = {}
+        w, _state["journal"], _state["journal_path"] = \
+            _state["journal"], None, None
+    if w is not None:
+        w.close()
+    _registry.reset()
+
+
+class StepRecord:
+    """Accumulates one step's phases; built only when monitoring is on."""
+
+    __slots__ = ("kind", "t0", "phases", "cache", "fingerprint", "extra")
+
+    def __init__(self, kind):
+        self.kind = kind
+        self.t0 = time.perf_counter()
+        self.phases = {}     # name -> seconds
+        self.cache = None    # "hit" | "miss"
+        self.fingerprint = None
+        self.extra = None    # journal-only extras
+
+    def phase(self, name, seconds):
+        self.phases[name] = self.phases.get(name, 0.0) + float(seconds)
+
+    @contextlib.contextmanager
+    def timed(self, name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phase(name, time.perf_counter() - t0)
+
+    def mark_cache(self, hit, fingerprint=None):
+        self.cache = "hit" if hit else "miss"
+        self.fingerprint = fingerprint
+        _registry.counter(
+            "compile_cache_hits_total" if hit else
+            "compile_cache_misses_total",
+            help="executor compile-cache lookups",
+            cache=self.kind).inc()
+
+
+def step_begin(kind="executor"):
+    """One step's record; callers gate on enabled() themselves so the
+    disabled path stays a single flag check."""
+    return StepRecord(kind)
+
+
+def fingerprint_of(cache_key):
+    """Short stable-within-process id of a compile-cache key (joins the
+    journal's cache lines with compile_info entries)."""
+    return format(hash(cache_key) & 0xFFFFFFFF, "08x")
+
+
+def record_compile(fingerprint, wall_s=None, flops=None,
+                   bytes_accessed=None):
+    """Fold one compile's wall time / HLO cost into compile_info and the
+    registry (per-fingerprint gauges)."""
+    with _lock:
+        info = _state["compile_info"].setdefault(str(fingerprint), {})
+        if wall_s is not None:
+            info["wall_s"] = float(wall_s)
+        if flops is not None:
+            info["flops"] = float(flops)
+        if bytes_accessed is not None:
+            info["bytes_accessed"] = float(bytes_accessed)
+    if wall_s is not None:
+        _registry.gauge("compile_wall_seconds",
+                        help="XLA compile wall time per program fingerprint",
+                        fingerprint=str(fingerprint)).set(wall_s)
+    if flops is not None:
+        _registry.gauge("hlo_flops",
+                        help="HLO cost analysis: FLOPs per dispatch",
+                        fingerprint=str(fingerprint)).set(flops)
+    if bytes_accessed is not None:
+        _registry.gauge("hlo_bytes_accessed",
+                        help="HLO cost analysis: bytes accessed per dispatch",
+                        fingerprint=str(fingerprint)).set(bytes_accessed)
+
+
+def compile_info():
+    """{fingerprint: {wall_s, flops, bytes_accessed}} snapshot."""
+    with _lock:
+        return {k: dict(v) for k, v in _state["compile_info"].items()}
+
+
+def compile_probe(fingerprint):
+    """Probe for executor_core.compile_step_fn: lower the jitted step once
+    (before its first execution — donated inputs are still alive) and
+    record the HLO cost analysis under `fingerprint`."""
+
+    def probe(jitted, args):
+        try:
+            ca = jitted.lower(*args).cost_analysis()
+        except Exception:
+            return
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if not isinstance(ca, dict):
+            return
+        record_compile(
+            fingerprint,
+            flops=float(ca.get("flops", 0.0) or 0.0),
+            bytes_accessed=float(ca.get("bytes accessed", 0.0) or 0.0))
+
+    return probe
+
+
+def cache_evicted(kind="executor"):
+    """Count one compile-cache eviction (FLAGS_compile_cache_cap)."""
+    _registry.counter("compile_cache_evictions_total",
+                      help="compile-cache entries evicted by the cap",
+                      cache=kind).inc()
+
+
+def _journal_writer():
+    path = flags.get("monitor_journal")
+    if not path:
+        return None
+    with _lock:
+        if _state["journal_path"] != path:
+            old = _state["journal"]
+            if old is not None:
+                old.close()
+            _state["journal"] = JournalWriter(path)
+            _state["journal_path"] = path
+        return _state["journal"]
+
+
+def step_end(rec, iters=None, datapipe=None, replica_ms=None,
+             replica_ids=None):
+    """Close one StepRecord: registry metrics, last_step capture, journal.
+
+    datapipe: the DataPipe the step pulled from (its per-step stage-stat
+    deltas merge into the record); replica_ms/replica_ids: per-replica
+    completion stamps from skew.measure_replica_ms."""
+    if rec is None:
+        return None
+    total_ms = (time.perf_counter() - rec.t0) * 1000.0
+    _registry.counter("steps_total", help="executor steps run",
+                      kind=rec.kind).inc()
+    _registry.histogram("step_ms", help="wall time per executor step",
+                        kind=rec.kind).observe(total_ms)
+    _registry.gauge("last_step_ms", help="wall time of the last step",
+                    kind=rec.kind).set(total_ms)
+    phases_ms = {}
+    for name, s in rec.phases.items():
+        ms = s * 1000.0
+        phases_ms[name] = round(ms, 6)
+        _registry.histogram("step_phase_ms",
+                            help="per-phase wall time within a step",
+                            kind=rec.kind, phase=name).observe(ms)
+        _registry.gauge("last_phase_ms", kind=rec.kind, phase=name).set(ms)
+
+    with _lock:
+        _state["steps"] += 1
+        step_idx = _state["steps"]
+    record = {
+        "ts": time.time(),
+        "step": step_idx,
+        "kind": rec.kind,
+        "iters": iters,
+        "total_ms": round(total_ms, 6),
+        "phases_ms": phases_ms,
+    }
+    if rec.cache is not None:
+        record["cache"] = rec.cache
+        record["fingerprint"] = rec.fingerprint
+    if rec.extra:
+        record.update(rec.extra)
+
+    if datapipe is not None:
+        try:
+            delta = (datapipe.stats_delta()
+                     if hasattr(datapipe, "stats_delta")
+                     else datapipe.stats())
+        except Exception:
+            delta = None
+        if delta:
+            record["datapipe"] = delta
+        wire = getattr(datapipe, "wire_spec", None)
+        if wire is not None and hasattr(wire, "describe"):
+            record["wire"] = wire.describe()
+
+    if replica_ms:
+        sk = replica_skew(replica_ms, ids=replica_ids)
+        record["replica_ms"] = [round(t, 6) for t in replica_ms]
+        if replica_ids is not None:
+            record["replica_ids"] = list(replica_ids)
+        record["skew"] = sk
+        for i, t in enumerate(replica_ms):
+            rid = replica_ids[i] if replica_ids is not None else i
+            _registry.gauge("replica_step_ms",
+                            help="per-replica step completion time",
+                            replica=str(rid)).set(t)
+        if sk["max_over_median"] is not None:
+            _registry.gauge("replica_skew_max_over_median",
+                            help="straggler signal: max/median "
+                                 "per-replica step time").set(
+                sk["max_over_median"])
+
+    with _lock:
+        _state["last"] = record
+    writer = _journal_writer()
+    if writer is not None:
+        writer.write(record)
+    return record
+
+
+def last_step():
+    """The most recent completed step record (dict), or None."""
+    with _lock:
+        rec = _state["last"]
+        return dict(rec) if rec is not None else None

@@ -18,12 +18,15 @@ Multi-node ("NCCL2 mode", num_trainers/trainer_id) maps to jax.distributed
 with a mesh spanning hosts; see parallel/distributed.py.
 """
 
+import time
+
 import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import amp
 from . import flags
+from . import monitor
 from .core import executor_core
 from .core.framework import Parameter, Variable, default_main_program
 from .core.lod_tensor import LoDTensor
@@ -179,11 +182,20 @@ class ParallelExecutor:
         compiled step, single-use chunks donated. `async_fetch=True`
         returns FetchFuture handles instead of host arrays."""
         _apply_debug_nans()
+        # single flag check when monitoring is off (same contract as
+        # Executor.run); every site below gates on `mon is not None`
+        mon = monitor.step_begin("parallel_executor") \
+            if monitor.enabled() else None
         feed = feed if feed is not None else feed_dict
-        if hasattr(feed, "next_feed"):  # datapipe.DataPipe (duck-typed)
+        pipe = feed if hasattr(feed, "next_feed") else None
+        if pipe is not None:  # datapipe.DataPipe (duck-typed)
             if iters is None:
-                iters = getattr(feed, "feed_iters", None)
-            feed = feed.next_feed()
+                iters = getattr(pipe, "feed_iters", None)
+            if mon is not None:
+                with mon.timed("feed_wait"):
+                    feed = pipe.next_feed()
+            else:
+                feed = pipe.next_feed()
         from .datapipe.transfer import pop_markers
         feed, wire, chunk_donate = pop_markers(feed)
         if donate_feeds is None:
@@ -202,6 +214,7 @@ class ParallelExecutor:
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
 
         program, scope = self._program, self._scope
+        t_enc = time.perf_counter() if mon is not None else None
         feed_vals = {}
         if iters is not None:
             # shared stacking helper: list-length and leading-axis checks,
@@ -219,6 +232,9 @@ class ParallelExecutor:
             for name, value in feed.items():
                 tv = executor_core.feed_to_tracevalue(value)
                 feed_vals[name] = self._feed_sharding(tv)
+        if mon is not None:
+            # stacking + device_put onto the mesh (the h2d link for feeds)
+            mon.phase("feed_encode", time.perf_counter() - t_enc)
 
         state_names, state_out_names = executor_core.collect_state_names(program, scope)
         cache_key = (
@@ -235,7 +251,13 @@ class ParallelExecutor:
             ("donate_feeds", donate_feeds),
         )
         entry = self._compile_cache.get(cache_key)
+        fp = monitor.fingerprint_of(cache_key) if mon is not None else None
+        if mon is not None:
+            mon.mark_cache(entry is not None, fingerprint=fp)
+        build_s = 0.0
+        was_miss = entry is None
         if entry is None:
+            tb = time.perf_counter()
             step = executor_core.build_step_fn(program, fetch_names, state_out_names)
             if wire is not None:
                 # decode in the PER-STEP fn (before the scan wrapper), so
@@ -254,10 +276,19 @@ class ParallelExecutor:
                         f"scope before the scan; missing: {missing}. Run "
                         f"the startup program first.")
                 step = executor_core.build_multi_step_fn(step, iters)
+            probe = monitor.compile_probe(fp) \
+                if mon is not None and flags.get("monitor_hlo_cost") else None
             compiled = executor_core.compile_step_fn(
                 step, donate_state=not flags.get("debug_nans"),
-                donate_feeds=donate_feeds)
+                donate_feeds=donate_feeds, probe=probe)
+            build_s = time.perf_counter() - tb
             entry = (compiled, state_names, state_out_names)
+            cap = flags.get("compile_cache_cap")
+            if cap and cap > 0:
+                while len(self._compile_cache) >= cap:
+                    self._compile_cache.pop(next(iter(self._compile_cache)))
+                    if mon is not None:
+                        monitor.cache_evicted(mon.kind)
             self._compile_cache[cache_key] = entry
         compiled, state_names, state_out_names = entry
 
@@ -310,8 +341,27 @@ class ParallelExecutor:
         else:
             rng = jax.random.fold_in(base_key, self._step)
             self._step += 1
+        tc = time.perf_counter() if mon is not None else None
         with self._mesh:
             fetches, new_mut = compiled(mut_state, const_state, feed_vals, rng)
+        replica_ms = replica_ids = None
+        if mon is not None:
+            if flags.get("monitor_replica_skew"):
+                # fence each replica's shard of a step output in device
+                # order — stamps per-replica completion. Synchronizes the
+                # dispatch queue, hence the separate opt-in flag.
+                leaf = fetches[0] if fetches else \
+                    next(iter(new_mut.values()), None)
+                if leaf is not None:
+                    res = monitor.measure_replica_ms(leaf, tc)
+                    if res is not None:
+                        replica_ms, replica_ids = res
+            call_s = time.perf_counter() - tc
+            if was_miss:  # first call compiles under async dispatch
+                mon.phase("compile", build_s + call_s)
+                monitor.record_compile(fp, wall_s=build_s + call_s)
+            else:
+                mon.phase("dispatch", call_s)
         for n, v in new_mut.items():
             scope.set_var(n, v)
         outs = [
@@ -321,9 +371,16 @@ class ParallelExecutor:
         if async_fetch:
             from .executor import FetchFuture
 
-            return [FetchFuture(o) for o in outs]
-        if return_numpy:
-            return [as_numpy(o) for o in outs]
+            outs = [FetchFuture(o) for o in outs]
+        elif return_numpy:
+            if mon is not None:
+                with mon.timed("fetch_readback"):
+                    outs = [as_numpy(o) for o in outs]
+            else:
+                outs = [as_numpy(o) for o in outs]
+        if mon is not None:
+            monitor.step_end(mon, iters=iters, datapipe=pipe,
+                             replica_ms=replica_ms, replica_ids=replica_ids)
         return outs
 
     def bcast_params(self):

@@ -7,6 +7,7 @@ the reference's RecordEvent aggregation (profiler.cc:326 ParseEvents) so
 """
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
 
@@ -19,6 +20,11 @@ __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
 _host_events = []  # (name, start, end)
 _counter_events = []  # (name, t, value) — chrome-trace "C" counter samples
 _byte_totals = defaultdict(float)  # name -> cumulative bytes (record_bytes)
+# one lock for the counter/byte tables: datapipe feeder threads and the
+# executor thread report concurrently, and a record_bytes total-update +
+# sample-append must be atomic or a racing thread publishes a stale
+# cumulative point (a dip in a monotone MB track)
+_rec_lock = threading.Lock()
 _enabled = False
 _trace_dir = None
 _last_trace_dir = None  # survives stop_profiler so export can merge
@@ -50,7 +56,8 @@ def record_counter(name, value):
     """Sample a named counter (e.g. a datapipe queue depth); rendered as a
     chrome-trace counter track ("ph": "C") in export_chrome_trace."""
     if _enabled:
-        _counter_events.append((name, time.perf_counter(), float(value)))
+        with _rec_lock:
+            _counter_events.append((name, time.perf_counter(), float(value)))
 
 
 def record_bytes(name, nbytes):
@@ -58,16 +65,19 @@ def record_bytes(name, nbytes):
     bytes); rendered as a cumulative MB counter track in the merged chrome
     trace, so per-link throughput reads off the track's slope."""
     if _enabled:
-        _byte_totals[name] += float(nbytes)
-        _counter_events.append(
-            (name + "/MB", time.perf_counter(), _byte_totals[name] / 1e6))
+        with _rec_lock:
+            _byte_totals[name] += float(nbytes)
+            _counter_events.append(
+                (name + "/MB", time.perf_counter(),
+                 _byte_totals[name] / 1e6))
 
 
 def reset_profiler():
     global _last_trace_dir, _trace_t0
     del _host_events[:]
-    del _counter_events[:]
-    _byte_totals.clear()
+    with _rec_lock:
+        del _counter_events[:]
+        _byte_totals.clear()
     _last_trace_dir = None
     _trace_t0 = None
 
@@ -207,8 +217,21 @@ def export_chrome_trace(path):
 
 @contextlib.contextmanager
 def cuda_profiler(output_file, output_mode=None, config=None):
-    """API parity with reference profiler.py:33; maps to a jax trace."""
-    jax.profiler.start_trace(output_file if "/" in str(output_file) else "/tmp/jax_trace")
+    """API parity with reference profiler.py:33; maps to a jax trace.
+
+    output_file names the trace DIRECTORY (honoured as given — the old
+    '"/" in str(...)' heuristic silently redirected bare names to
+    /tmp/jax_trace). The dir is also published as _last_trace_dir with the
+    session's time origin, so a following export_chrome_trace merges this
+    block's device lane instead of dropping it."""
+    global _last_trace_dir, _trace_t0
+    trace_dir = str(output_file) if output_file else "/tmp/jax_trace"
+    jax.profiler.start_trace(trace_dir)
+    _last_trace_dir = trace_dir
+    if _trace_t0 is None:
+        # keep an enclosing start_profiler's origin; otherwise this block
+        # defines the merged timeline's zero
+        _trace_t0 = time.perf_counter()
     try:
         yield
     finally:

@@ -7,6 +7,7 @@ import contextlib
 import os
 
 from . import core
+from . import monitor as monitor_mod
 from .core.framework import Program, program_guard, default_main_program, default_startup_program
 from .core.places import CPUPlace, TPUPlace
 from .core.scope import Scope, global_scope, scope_guard
@@ -40,10 +41,14 @@ class BeginStepEvent:
 
 
 class EndStepEvent:
-    def __init__(self, epoch_id, step_id, metrics):
+    def __init__(self, epoch_id, step_id, metrics, monitor=None):
         self.epoch = epoch_id
         self.step = step_id
         self.metrics = metrics
+        # paddle_tpu.monitor step record of the run that produced metrics
+        # (dict: total_ms, phases_ms, cache, ... — see monitor/journal.py),
+        # or None when FLAGS_monitor=0
+        self.monitor = monitor
 
 
 class CheckpointConfig:
@@ -215,7 +220,10 @@ class Trainer:
                 )
                 metrics = exe.run(self.train_program, feed=staged,
                                   fetch_list=fetch, iters=iters)
-                event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                snap = monitor_mod.last_step() \
+                    if monitor_mod.enabled() else None
+                event_handler(EndStepEvent(epoch_id, step_id, metrics,
+                                           monitor=snap))
             event_handler(EndEpochEvent(epoch_id))
 
     def _train_by_executor(self, num_epochs, event_handler, reader, feed_order):
@@ -250,7 +258,10 @@ class Trainer:
                         else []
                     )
                     metrics = run(feeder.feed(data), fetch)
-                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                    snap = monitor_mod.last_step() \
+                        if monitor_mod.enabled() else None
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics,
+                                               monitor=snap))
                     step += 1
                     if (
                         self.checkpoint_cfg

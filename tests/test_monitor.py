@@ -1,0 +1,402 @@
+"""paddle_tpu.monitor: registry semantics, step journal, compile-cache
+visibility, replica skew, MFU accounting, and the disabled-mode
+zero-overhead contract (FLAGS_monitor=0 => ONE flag check per step)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags, monitor, profiler
+from paddle_tpu.datapipe.stats import PipeStats
+from paddle_tpu.monitor.registry import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_monitor():
+    monitor.reset()
+    yield
+    monitor.reset()
+
+
+def _tiny_program(size=3):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        loss = fluid.layers.reduce_mean(fluid.layers.fc(input=x, size=size))
+    return main, startup, loss
+
+
+def _feed(batch=4):
+    return {"x": np.ones((batch, 4), np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram():
+    reg = MetricsRegistry()
+    c = reg.counter("steps_total", kind="executor")
+    c.inc()
+    c.inc(3)
+    assert c.value == 4
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # same (name, labels) -> same object; different labels -> new series
+    assert reg.counter("steps_total", kind="executor") is c
+    assert reg.counter("steps_total", kind="eager") is not c
+
+    g = reg.gauge("last_step_ms")
+    g.set(12.5)
+    assert g.value == 12.5
+    g.add(0.5)
+    assert g.value == 13.0
+
+    h = reg.histogram("step_ms", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(555.5)
+    assert snap["min"] == 0.5 and snap["max"] == 500.0
+    # cumulative buckets, +Inf catches the overflow observation
+    assert snap["buckets"][1.0] == 1
+    assert snap["buckets"][10.0] == 2
+    assert snap["buckets"][100.0] == 3
+    assert snap["buckets"]["+Inf"] == 4
+
+    # kind mismatch on a registered name is an error, not a silent replace
+    with pytest.raises(TypeError):
+        reg.gauge("steps_total", kind="executor")
+
+    snapshot = reg.snapshot()
+    assert snapshot['steps_total{kind="executor"}'] == 4
+    assert snapshot["last_step_ms"] == 13.0
+
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+def test_registry_exposition_format():
+    reg = MetricsRegistry()
+    reg.counter("steps_total", help="steps run", kind="executor").inc(2)
+    reg.gauge("last_step_ms").set(1.5)
+    reg.histogram("step_ms", buckets=(10.0,)).observe(3.0)
+    text = reg.exposition()
+    assert "# HELP steps_total steps run" in text
+    assert "# TYPE steps_total counter" in text
+    assert 'steps_total{kind="executor"} 2.0' in text
+    assert "last_step_ms 1.5" in text
+    assert 'step_ms_bucket{le="10.0"} 1' in text
+    assert 'step_ms_bucket{le="+Inf"} 1' in text
+    assert "step_ms_sum 3.0" in text
+    assert "step_ms_count 1" in text
+
+
+# ---------------------------------------------------------------------------
+# step records through the real executor
+# ---------------------------------------------------------------------------
+
+def test_compile_cache_hit_miss_and_phases():
+    main, startup, loss = _tiny_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+        first = monitor.last_step()
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+        second = monitor.last_step()
+
+    assert first["kind"] == "executor"
+    assert first["cache"] == "miss"
+    assert "compile" in first["phases_ms"]
+    assert second["cache"] == "hit"
+    assert "dispatch" in second["phases_ms"]
+    assert second["fingerprint"] == first["fingerprint"]
+    assert "feed_encode" in second["phases_ms"]
+    assert "fetch_readback" in second["phases_ms"]
+    assert second["total_ms"] > 0
+
+    snap = monitor.registry().snapshot()
+    assert snap['compile_cache_misses_total{cache="executor"}'] >= 1
+    assert snap['compile_cache_hits_total{cache="executor"}'] == 1
+    # the miss's compile wall time landed in compile_info per fingerprint
+    info = monitor.compile_info()
+    assert first["fingerprint"] in info
+    assert info[first["fingerprint"]]["wall_s"] > 0
+
+
+def test_multi_step_iters_recorded():
+    main, startup, loss = _tiny_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        K = 3
+        feeds = {"x": np.ones((K, 4, 4), np.float32)}
+        exe.run(main, feed=feeds, fetch_list=[loss], iters=K)
+        rec = monitor.last_step()
+    assert rec["iters"] == 3
+    assert rec["cache"] == "miss"
+
+
+def test_disabled_mode_is_one_flag_check(monkeypatch):
+    """FLAGS_monitor=0: exe.run costs exactly ONE monitor.enabled() call —
+    no StepRecord, no registry mutation, no last_step capture."""
+    main, startup, loss = _tiny_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed=_feed(), fetch_list=[loss])  # warm the cache
+        monitor.reset()
+
+        calls = []
+        real_enabled = monitor.enabled
+        monkeypatch.setattr(monitor, "enabled",
+                            lambda: calls.append(1) or real_enabled())
+
+        def boom(*a, **k):  # step_begin must never run when disabled
+            raise AssertionError("step_begin called with FLAGS_monitor=0")
+
+        monkeypatch.setattr(monitor, "step_begin", boom)
+        with flags.flag_guard(monitor=False):
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+            assert len(calls) == 1
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+            assert len(calls) == 2
+    assert monitor.last_step() is None
+    assert monitor.registry().snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+def test_journal_roundtrip_schema(tmp_path):
+    journal = str(tmp_path / "steps.jsonl")
+    main, startup, loss = _tiny_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with flags.flag_guard(monitor_journal=journal):
+            for _ in range(3):
+                exe.run(main, feed=_feed(), fetch_list=[loss])
+    records = monitor.read_journal(journal)
+    assert len(records) == 3
+    steps = [r["step"] for r in records]
+    assert steps == sorted(steps)
+    for r in records:
+        assert r["kind"] == "executor"
+        assert r["total_ms"] > 0
+        assert isinstance(r["phases_ms"], dict) and r["phases_ms"]
+        assert r["cache"] in ("hit", "miss")
+        assert isinstance(r["fingerprint"], str)
+        assert r["ts"] > 0
+    assert records[0]["cache"] == "miss"
+    assert records[-1]["cache"] == "hit"
+
+    # every line is standalone JSON (torn-line tolerance comes free)
+    with open(journal) as f:
+        for line in f:
+            json.loads(line)
+
+    summary = monitor.summarize_journal(records)
+    assert summary["steps"] == 3
+    assert summary["cache"] == {"hit": 2, "miss": 1}
+    assert summary["step_ms"]["mean"] > 0
+    text = monitor.format_summary(summary)
+    assert "steps: 3" in text and "compile cache: 2 hits / 1 misses" in text
+
+
+def test_journal_skips_torn_final_line(tmp_path):
+    p = tmp_path / "torn.jsonl"
+    p.write_text('{"step": 1, "total_ms": 2.0}\n{"step": 2, "tot')
+    records = monitor.read_journal(str(p))
+    assert [r["step"] for r in records] == [1]
+
+
+# ---------------------------------------------------------------------------
+# compile-cache cap + HLO cost capture
+# ---------------------------------------------------------------------------
+
+def test_compile_cache_cap_evicts_and_counts():
+    main1, startup1, loss1 = _tiny_program(size=3)
+    main2, startup2, loss2 = _tiny_program(size=5)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup1)
+        exe.run(startup2)
+        with flags.flag_guard(compile_cache_cap=1):
+            exe.run(main1, feed=_feed(), fetch_list=[loss1])
+            exe.run(main2, feed=_feed(), fetch_list=[loss2])  # evicts main1
+            assert len(exe._compile_cache) == 1
+            exe.run(main1, feed=_feed(), fetch_list=[loss1])  # miss again
+            assert monitor.last_step()["cache"] == "miss"
+    snap = monitor.registry().snapshot()
+    assert snap['compile_cache_evictions_total{cache="executor"}'] >= 2
+
+
+def test_hlo_cost_captured_at_lowering():
+    main, startup, loss = _tiny_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with flags.flag_guard(monitor_hlo_cost=True):
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+        fp = monitor.last_step()["fingerprint"]
+    info = monitor.compile_info()
+    assert info[fp]["flops"] > 0  # the fc matmul's FLOPs, per XLA
+    assert info[fp]["wall_s"] > 0
+    snap = monitor.registry().snapshot()
+    assert snap[f'hlo_flops{{fingerprint="{fp}"}}'] == info[fp]["flops"]
+
+
+# ---------------------------------------------------------------------------
+# replica skew
+# ---------------------------------------------------------------------------
+
+def test_replica_skew_math():
+    sk = monitor.replica_skew([10.0, 10.2, 9.9, 20.0])
+    assert sk["replicas"] == 4
+    assert sk["max_ms"] == 20.0
+    assert sk["median_ms"] == pytest.approx(10.1)
+    assert sk["max_over_median"] == pytest.approx(20.0 / 10.1, rel=1e-4)
+    assert sk["slowest"] == 3
+
+    sk = monitor.replica_skew([5.0, 7.0], ids=[12, 3])
+    assert sk["slowest"] == 3  # id of the worst replica, not its index
+
+    assert monitor.replica_skew([0.0, 0.0])["max_over_median"] is None
+    with pytest.raises(ValueError):
+        monitor.replica_skew([])
+
+
+def test_parallel_executor_records_skew():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-device virtual mesh")
+    main, startup, loss = _tiny_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                    main_program=main)
+        with flags.flag_guard(monitor_replica_skew=True):
+            pe.run([loss.name], feed={"x": np.ones((16, 4), np.float32)})
+            rec = monitor.last_step()
+    assert rec["kind"] == "parallel_executor"
+    assert len(rec["replica_ms"]) == pe.device_count
+    assert rec["skew"]["replicas"] == pe.device_count
+    assert rec["skew"]["max_over_median"] >= 1.0
+    assert rec["skew"]["slowest"] in rec["replica_ids"]
+    snap = monitor.registry().snapshot()
+    assert "replica_skew_max_over_median" in snap
+
+
+# ---------------------------------------------------------------------------
+# profiler integration + FLAGS_benchmark routing
+# ---------------------------------------------------------------------------
+
+def test_monitor_gauges_land_as_chrome_counter_tracks(tmp_path):
+    profiler.reset_profiler()
+    profiler.start_profiler("All")  # no device trace needed
+    try:
+        main, startup, loss = _tiny_program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+    finally:
+        profiler.stop_profiler(profile_path=str(tmp_path / "prof"))
+    out = profiler.export_chrome_trace(str(tmp_path / "merged.json"))
+    with open(out) as f:
+        events = json.load(f)["traceEvents"]
+    tracks = {e["name"] for e in events if e.get("ph") == "C"}
+    assert any(name.startswith("monitor/last_step_ms") for name in tracks), \
+        tracks
+    assert any(name.startswith("monitor/last_phase_ms") for name in tracks)
+
+
+def test_flags_benchmark_routes_through_registry(capfd):
+    main, startup, loss = _tiny_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with flags.flag_guard(benchmark=True):
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+    err = capfd.readouterr().err
+    assert "[paddle_tpu] run:" in err
+    snap = monitor.registry().snapshot()
+    assert snap["benchmark_run_ms"] > 0
+    assert snap["benchmark_run_ms_hist"]["count"] == 1
+    # the printed line is a formatting of the recorded gauge value
+    printed = float(err.split("run: ")[1].split(" ms")[0])
+    assert printed == pytest.approx(snap["benchmark_run_ms"], abs=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# MFU accounting
+# ---------------------------------------------------------------------------
+
+def test_chip_peak_table_and_override():
+    class FakeDev:
+        device_kind = "TPU v4"
+
+    assert monitor.chip_peak_flops(FakeDev()) == 275.0e12
+
+    class FakeV5e:
+        device_kind = "TPU v5 lite"  # longest match wins over "TPU v5p"?
+
+    assert monitor.chip_peak_flops(FakeV5e()) == 197.0e12
+
+    class Unknown:
+        device_kind = "SuperChip 9000"
+
+    assert monitor.chip_peak_flops(Unknown()) is None
+    with flags.flag_guard(monitor_chip_peak_tflops=100.0):
+        assert monitor.chip_peak_flops(Unknown()) == 100.0e12
+
+
+def test_mfu_math():
+    # 1e12 FLOPs/step at 100 steps/s on a 2e14-peak chip = 50% MFU
+    assert monitor.mfu(1e12, 100.0, peak_flops=2e14) == pytest.approx(0.5)
+    assert monitor.mfu(None, 100.0, peak_flops=2e14) is None
+    assert monitor.mfu(1e12, 0.0, peak_flops=2e14) is None
+
+    class Unknown:
+        device_kind = "cpu"  # no table peak -> mfu null, not a fiction
+
+    assert monitor.mfu(1e12, 100.0, device=Unknown()) is None
+
+
+# ---------------------------------------------------------------------------
+# datapipe stats delta (journal merge source)
+# ---------------------------------------------------------------------------
+
+def test_pipe_stats_delta_is_per_interval():
+    ps = PipeStats()
+    st = ps.stage("map")
+    st.add_item(busy_s=0.5, nbytes=100)
+    st.add_item(busy_s=0.5, nbytes=100)
+    d1 = ps.delta()
+    assert d1["map"]["items"] == 2
+    assert d1["map"]["bytes"] == 200
+    assert d1["map"]["busy_s"] == pytest.approx(1.0)
+    st.add_item(busy_s=0.25, nbytes=50)
+    d2 = ps.delta()
+    assert d2["map"]["items"] == 1  # only what happened since d1
+    assert d2["map"]["bytes"] == 50
+    assert d2["map"]["busy_s"] == pytest.approx(0.25)
+    d3 = ps.delta()
+    assert d3["map"]["items"] == 0

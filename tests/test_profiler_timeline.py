@@ -55,6 +55,63 @@ def test_merged_timeline_has_both_lanes(tmp_path):
     assert -1e6 < span["ts"] < dev_end + 5e6, (span["ts"], dev_end)
 
 
+def test_cuda_profiler_merges_device_lane(tmp_path):
+    """Regression: cuda_profiler never published its trace dir, so a
+    following export_chrome_trace silently dropped the device lane; it
+    also redirected bare output names to /tmp/jax_trace."""
+    trace_dir = str(tmp_path / "cuda_trace")
+    profiler.reset_profiler()
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[32], dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.fc(input=x, size=32))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with profiler.cuda_profiler(trace_dir):
+        for _ in range(2):
+            exe.run(main, feed={"x": np.ones((4, 32), "float32")},
+                    fetch_list=[loss])
+
+    # output_file honoured as given, and published for the export merge
+    assert profiler._last_trace_dir == trace_dir
+    out = profiler.export_chrome_trace(str(tmp_path / "merged.json"))
+    with open(out) as f:
+        events = json.load(f)["traceEvents"]
+    dev = [e for e in events if e.get("pid", 0) >= 100]
+    assert dev, "cuda_profiler device lane missing from the merged trace"
+
+
+def test_record_bytes_concurrent_totals_are_monotone():
+    """record_bytes mutates the byte total + appends a paired counter
+    sample; without the lock, racing feeder threads publish stale
+    cumulative points (dips in a monotone MB track)."""
+    import threading
+
+    profiler.reset_profiler()
+    profiler._enabled = True
+    try:
+        n_threads, n_each = 4, 200
+
+        def pump():
+            for _ in range(n_each):
+                profiler.record_bytes("lane", 1000)
+
+        threads = [threading.Thread(target=pump) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        profiler._enabled = False
+    assert profiler._byte_totals["lane"] == n_threads * n_each * 1000
+    samples = [v for name, _, v in profiler._counter_events
+               if name == "lane/MB"]
+    assert len(samples) == n_threads * n_each
+    assert samples == sorted(samples), "cumulative MB track not monotone"
+    profiler.reset_profiler()
+
+
 def test_export_without_device_trace_is_host_only(tmp_path):
     profiler.reset_profiler()
     profiler._last_trace_dir = None

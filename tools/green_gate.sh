@@ -12,4 +12,53 @@ if [ $rc -ne 0 ]; then
     echo "$out" | grep -E "^FAILED|^ERROR" >&2
     exit 1
 fi
+
+# monitor smoke: a real exe.run must write a parseable step journal and a
+# non-empty Prometheus exposition (paddle_tpu.monitor end-to-end)
+JAX_PLATFORMS=cpu python - <<'EOF'
+import tempfile
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import flags, monitor
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    loss = fluid.layers.reduce_mean(fluid.layers.fc(input=x, size=3))
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(startup)
+journal = tempfile.mktemp(suffix=".jsonl")
+with flags.flag_guard(monitor_journal=journal):
+    for _ in range(2):
+        exe.run(main, feed={"x": np.ones((4, 4), np.float32)},
+                fetch_list=[loss])
+monitor_records = monitor.read_journal(journal)
+assert len(monitor_records) == 2, monitor_records
+for r in monitor_records:
+    assert r["total_ms"] > 0 and r["phases_ms"], r
+assert monitor_records[-1]["cache"] == "hit", monitor_records[-1]
+exposition = monitor.exposition()
+assert "steps_total" in exposition and exposition.strip(), exposition
+print("monitor smoke: ok")
+EOF
+if [ $? -ne 0 ]; then
+    echo "GATE: MONITOR SMOKE RED — do not commit" >&2
+    exit 1
+fi
+
+# bench --dry must emit the MFU-accounting keys the BENCH artifact carries
+dry_out=$(JAX_PLATFORMS=cpu python bench.py --dry | tail -1)
+printf '%s' "$dry_out" | python -c '
+import json, sys
+result = json.loads(sys.stdin.read())
+for key in ("mfu", "model_flops_per_step", "step_ms_breakdown"):
+    assert key in result, (key, result)
+assert result["step_ms_breakdown"], result
+print("bench --dry: ok")
+'
+if [ $? -ne 0 ]; then
+    echo "GATE: BENCH --dry RED — do not commit" >&2
+    exit 1
+fi
+
 echo "GATE: green"
