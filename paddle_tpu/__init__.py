@@ -98,6 +98,8 @@ from .pipeline import DeviceChunkFeeder
 from . import datapipe
 from .datapipe import DataPipe, AsyncDeviceFeeder
 from . import monitor
+from . import resilience
+from .resilience import ResilienceConfig, ResilientRunner
 from . import dataset
 from . import parallel
 from .minibatch import batch
@@ -122,5 +124,6 @@ __all__ = [
     "InferenceTranspiler", "memory_optimize", "release_memory",
     "reader", "dataset", "batch", "unique_name", "parallel", "flags",
     "concurrency", "pipeline", "DeviceChunkFeeder", "datapipe", "DataPipe",
-    "AsyncDeviceFeeder", "monitor",
+    "AsyncDeviceFeeder", "monitor", "resilience", "ResilienceConfig",
+    "ResilientRunner",
 ]

@@ -15,6 +15,11 @@ Commands:
   monitor JOURNAL    summarize a FLAGS_monitor_journal step journal
                      (step/phase timings, compile-cache hit rate, replica
                      skew); --json emits the summary as JSON.
+  checkpoint inspect DIR [--serial N]
+                     list a checkpoint directory's serials and their
+                     commit status (committed / incomplete / orphaned
+                     .tmp) and show the latest (or chosen) manifest;
+                     --json emits the report as JSON.
 """
 
 import argparse
@@ -63,6 +68,43 @@ def _cmd_monitor(args):
     return 0
 
 
+def _cmd_checkpoint(args):
+    from .resilience import inspect_dir
+
+    try:
+        report = inspect_dir(args.dir, serial=args.serial)
+    except (OSError, ValueError) as e:
+        print(f"cannot inspect checkpoint dir: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        import json
+
+        print(json.dumps(report, indent=2))
+        return 0
+    print(f"checkpoint dir: {report['checkpoint_dir']}")
+    if not report["serials"]:
+        print("  (no checkpoints)")
+        return 0
+    for ent in report["serials"]:
+        print(f"  {ent['dir']:<24} serial={ent['serial']!s:<6} "
+              f"{ent['status']:<12} {ent['bytes']} bytes")
+    print(f"latest committed serial: {report['latest']}")
+    manifest = report.get("manifest")
+    if manifest:
+        print(f"manifest (serial {manifest.get('serial')}): "
+              f"format={manifest.get('format')} step={manifest.get('step')}")
+        var_names = sorted((manifest.get("vars") or {}).keys())
+        print(f"  vars ({len(var_names)}): {', '.join(var_names[:8])}"
+              + (" ..." if len(var_names) > 8 else ""))
+        dp = manifest.get("datapipe")
+        if dp:
+            print(f"  datapipe: {dp}")
+    elif report.get("format"):
+        print(f"legacy io-format checkpoint (no manifest); files: "
+              f"{len(report.get('files', []))}")
+    return 0
+
+
 def _cmd_train(args):
     env = dict(os.environ)
     env["PADDLE_TRAINING_ROLE"] = args.role.upper()
@@ -89,6 +131,17 @@ def main(argv=None):
     m.add_argument("--json", action="store_true",
                    help="emit the summary as JSON instead of a table")
 
+    c = sub.add_parser("checkpoint", help="inspect checkpoint directories")
+    csub = c.add_subparsers(dest="checkpoint_action", required=True)
+    ci = csub.add_parser("inspect", help="list serials, commit status and "
+                                         "the manifest of a checkpoint dir")
+    ci.add_argument("dir", help="checkpoint directory "
+                                "(holds checkpoint_<N> subdirs)")
+    ci.add_argument("--serial", type=int, default=None,
+                    help="show this serial's manifest instead of the latest")
+    ci.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+
     t = sub.add_parser("train", help="launch a training script with "
                                      "cluster environment")
     t.add_argument("--role", default="trainer",
@@ -103,14 +156,21 @@ def main(argv=None):
     t.add_argument("script_args", nargs=argparse.REMAINDER)
 
     args = parser.parse_args(argv)
-    if args.command == "version":
-        return _cmd_version(args)
-    if args.command == "flags":
-        return _cmd_flags(args)
-    if args.command == "monitor":
-        return _cmd_monitor(args)
-    if args.command == "train":
-        return _cmd_train(args)
+    try:
+        if args.command == "version":
+            return _cmd_version(args)
+        if args.command == "flags":
+            return _cmd_flags(args)
+        if args.command == "monitor":
+            return _cmd_monitor(args)
+        if args.command == "checkpoint":
+            return _cmd_checkpoint(args)
+        if args.command == "train":
+            return _cmd_train(args)
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe; exit quietly
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
     parser.error(f"unknown command {args.command}")
 
 

@@ -144,6 +144,22 @@ class AsyncDeviceFeeder:
             with state["cond"]:
                 state["cond"].notify_all()
 
+    def join_workers(self, timeout=2.0):
+        """Join the live iteration's transfer threads (after close());
+        True when none is left running. Workers poll their stop flag at
+        0.2s granularity, so a closed pipeline drains within ~2 polls."""
+        state = self._active
+        if state is None:
+            return True
+        import time
+
+        ok = True
+        deadline = time.monotonic() + timeout
+        for t in state.get("threads", ()):
+            t.join(max(0.0, deadline - time.monotonic()))
+            ok = ok and not t.is_alive()
+        return ok
+
     def __iter__(self):
         import time
 
@@ -158,7 +174,8 @@ class AsyncDeviceFeeder:
         cond = threading.Condition()
         done = {}  # chunk idx -> staged dict
         state = {"next_in": 0, "next_out": 0, "eof_at": None,
-                 "error": None, "stop": False, "ended": 0, "cond": cond}
+                 "error": None, "stop": False, "ended": 0, "cond": cond,
+                 "threads": ()}
         self._active = state
         sst, tst = self._stack_stats, self._transfer_stats
         wire = self._wire
@@ -328,6 +345,7 @@ class AsyncDeviceFeeder:
                                     daemon=True,
                                     name=f"datapipe-feed-{i}")
                    for i in range(self._threads)]
+        state["threads"] = tuple(threads)
         for t in threads:
             t.start()
 
@@ -347,7 +365,10 @@ class AsyncDeviceFeeder:
                     if state["eof_at"] is not None and \
                             state["next_out"] >= state["eof_at"]:
                         return _End
-                    if state["ended"] == self._threads and not done:
+                    if state["ended"] == self._threads:
+                        # workers gone and next_out wasn't in `done` above:
+                        # EOF, error, or a stop that left a gap in the
+                        # reorder buffer — nothing more can arrive
                         if state["error"] is not None:
                             raise state["error"]
                         return _End

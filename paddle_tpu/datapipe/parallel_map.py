@@ -45,6 +45,31 @@ class ParallelMap:
                 f"would idle workers permanently")
         self._order = order
         self._stats = stats
+        self._active = None  # live iteration's state (for close/join)
+
+    def close(self):
+        """Stop the live iteration's workers (idempotent). Safe from any
+        thread — unlike closing the generator, which raises ValueError
+        when a downstream stage's worker is currently executing it."""
+        state = self._active
+        if state is not None:
+            state["stop"] = True
+            with state["cond"]:
+                state["cond"].notify_all()
+
+    def join_workers(self, timeout=2.0):
+        """Join the live iteration's worker threads (after close())."""
+        state = self._active
+        if state is None:
+            return True
+        import time
+
+        ok = True
+        deadline = time.monotonic() + timeout
+        for t in state.get("threads", ()):
+            t.join(max(0.0, deadline - time.monotonic()))
+            ok = ok and not t.is_alive()
+        return ok
 
     def __iter__(self):
         import time
@@ -56,7 +81,9 @@ class ParallelMap:
         done = {}          # idx -> result (order mode)
         ready = []         # results (unordered mode)
         state = {"next_in": 0, "next_out": 0, "eof_at": None,
-                 "error": None, "stop": False, "ended": 0}
+                 "error": None, "stop": False, "ended": 0, "cond": cond,
+                 "threads": ()}
+        self._active = state
         st = self._stats
 
         def pull():
@@ -121,6 +148,7 @@ class ParallelMap:
         threads = [threading.Thread(target=work, daemon=True,
                                     name=f"datapipe-map-{i}")
                    for i in range(self._workers)]
+        state["threads"] = tuple(threads)
         for t in threads:
             t.start()
 
@@ -140,10 +168,10 @@ class ParallelMap:
                     if state["eof_at"] is not None and \
                             state["next_out"] >= state["eof_at"]:
                         return _End
-                    if state["ended"] == self._workers and not done \
-                            and not ready:
-                        # workers gone without EOF mark: error already set
-                        # or consumer raced a stop; re-check then bail
+                    if state["ended"] == self._workers:
+                        # workers gone and nothing emittable was found
+                        # above: EOF, error, or a stop that left a gap in
+                        # the reorder buffer — no result can arrive now
                         if state["error"] is not None:
                             raise state["error"]
                         return _End
@@ -160,3 +188,5 @@ class ParallelMap:
             state["stop"] = True
             with cond:
                 cond.notify_all()
+            if self._active is state:
+                self._active = None

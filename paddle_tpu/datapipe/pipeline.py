@@ -28,7 +28,7 @@ pulled, which is the ring-reuse boundary batcher.py documents.
 
 from .batcher import Batcher
 from .parallel_map import ParallelMap
-from .source import GeneratorSource, RecordIOSource, Source
+from .source import GeneratorSource, RecordIOSource, SkipSource, Source
 from .stats import PipeStats
 
 __all__ = ["DataPipe"]
@@ -66,6 +66,11 @@ class DataPipe:
         self._stage_memo = {}  # op index -> StageStats (stable across iters)
         self._it = None        # persistent iterator for next_feed()
         self._layers = []      # built generators, innermost first
+        self._stage_objs = []  # built stage objects (close/join handles)
+        # source-position accounting for checkpoint/restore (resilience):
+        self._pass_emitted = 0      # items yielded to the consumer this pass
+        self._resume_base = 0       # records skipped at this pass's build
+        self._resume_records = None  # pending skip for the NEXT build
 
     # -- construction ----------------------------------------------------
     @classmethod
@@ -160,40 +165,53 @@ class DataPipe:
     def _build(self):
         from .feeder import AsyncDeviceFeeder
 
-        layers = []
-        cur = self._source
+        src = self._source
+        self._resume_base = 0
+        if self._resume_records:  # restore_state: fast-forward the source
+            src = SkipSource(src, self._resume_records)
+            self._resume_base = self._resume_records
+            self._resume_records = None
+        layers, objs = [], []
+        cur = src
         for i, (kind, kw) in enumerate(self._ops):
             if kind == "map":
-                cur = iter(ParallelMap(cur, stats=self._stage(i, "map"),
-                                       **kw))
+                obj = ParallelMap(cur, stats=self._stage(i, "map"), **kw)
             elif kind == "batch":
                 nxt = self._ops[i + 1] if i + 1 < len(self._ops) else None
                 zero_copy = bool(nxt and nxt[0] == "device"
                                  and nxt[1]["chunk"] is not None)
-                cur = iter(Batcher(cur, zero_copy=zero_copy,
-                                   stats=self._stage(i, "batch"), **kw))
+                obj = Batcher(cur, zero_copy=zero_copy,
+                              stats=self._stage(i, "batch"), **kw)
             elif kind == "device":
-                cur = iter(AsyncDeviceFeeder(
+                obj = AsyncDeviceFeeder(
                     cur, stack_stats=self._stage(i, "stack"),
                     transfer_stats=self._stage(i, "transfer"),
                     # one lane per transfer thread: link0..linkN-1 rows in
                     # stats() show whether the streams share the link's
                     # bandwidth or serialize on it
                     link_stats=lambda t, _i=i: self._stage(_i, f"link{t}"),
-                    **kw))
+                    **kw)
             else:  # pragma: no cover - builder invariant
                 raise AssertionError(f"unknown op {kind!r}")
+            cur = iter(obj)
             layers.append(cur)
-        return cur, layers
+            objs.append(obj)
+        return cur, layers, objs
 
     def __iter__(self):
-        cur, layers = self._build()
+        cur, layers, objs = self._build()
         self._layers = layers
+        self._stage_objs = objs
+        self._pass_emitted = 0
         if not layers:  # bare source
-            yield from cur
+            for item in cur:
+                self._pass_emitted += 1
+                yield item
             return
         try:
-            yield from cur
+            for item in cur:
+                self._pass_emitted += 1
+                yield item
         finally:
             self.close(_keep_it=True)
 
@@ -212,19 +230,66 @@ class DataPipe:
         self._it = None
 
     def close(self, _keep_it=False):
-        """Shut down every stage's worker threads (idempotent). Closing
-        only the outermost generator would strand inner stages' workers
-        blocked on their queues, so each built layer is closed explicitly,
-        outermost first."""
+        """Shut down every stage's worker threads (idempotent), even when
+        torn down mid-step. Generator .close() alone can't do this: an
+        inner stage's generator is EXECUTED BY the outer stage's worker
+        threads, so closing it from here raises "generator already
+        executing" and the inner workers leak. Instead: (1) flip every
+        stage's object-level stop flag (thread-safe), (2) join worker
+        threads outermost-first (workers poll stop at 0.2s granularity),
+        (3) only then close the generators — nothing is executing them
+        anymore."""
         if not _keep_it and self._it is not None:
             it, self._it = self._it, None
             it.close()
+        for obj in self._stage_objs:  # innermost first: EOF flows outward
+            close_fn = getattr(obj, "close", None)
+            if close_fn is not None:
+                close_fn()
+        for obj in reversed(self._stage_objs):
+            join = getattr(obj, "join_workers", None)
+            if join is not None:
+                join()
         for gen in reversed(self._layers):
             try:
                 gen.close()
             except Exception:
                 pass
         self._layers = []
+        self._stage_objs = []
+
+    # -- checkpoint/restore (paddle_tpu.resilience) ----------------------
+    def _records_per_item(self):
+        """Source records consumed per item the pipe emits (batch x chunk).
+        map stages are 1:1; exact for full batches, which drop_remainder
+        guarantees everywhere but the final partial tail."""
+        n = 1
+        for kind, kw in self._ops:
+            if kind == "batch":
+                n *= int(kw["batch_size"])
+            elif kind == "device" and kw["chunk"]:
+                n *= int(kw["chunk"])
+        return n
+
+    def checkpoint_state(self):
+        """Source position for a checkpoint manifest: how many (post-shard)
+        records the CONSUMER has seen this pass. Counted at emission — not
+        at the source, where prefetched-but-unconsumed records would be
+        wrongly marked consumed and dropped on restore."""
+        if self._resume_records is not None:  # restored, not yet iterated
+            return {"records": self._resume_records}
+        return {"records": self._resume_base
+                + self._pass_emitted * self._records_per_item(),
+                "emitted": self._pass_emitted}
+
+    def restore_state(self, state):
+        """Arrange for the next pass to skip the records a checkpoint
+        recorded as consumed (checkpoint_state). Takes effect at the next
+        build — call close()/reset() first if an iteration is live."""
+        records = int(state.get("records", 0))
+        self._resume_records = records if records > 0 else None
+        self._pass_emitted = 0
+        self._resume_base = 0
 
     def stats(self):
         """{stage: {items, bytes, busy_s, wait_in_s, wait_out_s, ...},

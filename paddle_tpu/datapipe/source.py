@@ -16,7 +16,7 @@ splits file lists per trainer in fluid_benchmark.py the same way).
 
 import os
 
-__all__ = ["Source", "GeneratorSource", "RecordIOSource",
+__all__ = ["Source", "GeneratorSource", "RecordIOSource", "SkipSource",
            "default_shard_assignment"]
 
 
@@ -43,6 +43,33 @@ class Source:
     def shard(self, num_shards, index):  # pragma: no cover - interface
         raise NotImplementedError(
             f"{type(self).__name__} does not support sharding")
+
+
+class SkipSource(Source):
+    """Resume wrapper: skip the first `skip` (post-shard) records of the
+    inner source's stream — how a restored DataPipe fast-forwards to its
+    checkpointed position without replaying consumed records. Generic
+    (works over any Source's iterator); RecordIO-native seek would avoid
+    the decode cost of the skipped prefix but not change what is
+    emitted."""
+
+    def __init__(self, inner, skip):
+        if skip < 0:
+            raise ValueError(f"skip must be >= 0, got {skip}")
+        self._inner = inner
+        self._skip = int(skip)
+
+    def shard(self, num_shards, index):  # pragma: no cover - not composed
+        raise NotImplementedError("shard before restore, not after")
+
+    def __iter__(self):
+        it = iter(self._inner)
+        for _ in range(self._skip):
+            try:
+                next(it)
+            except StopIteration:
+                return
+        yield from it
 
 
 class GeneratorSource(Source):

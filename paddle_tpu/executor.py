@@ -22,6 +22,8 @@ from .core.lod_tensor import LoDTensor
 from .core.places import CPUPlace, TPUPlace, jax_device_for
 from .core.scope import global_scope, Scope
 from .core.registry import SeqTensor
+from .resilience import chaos as _chaos
+from .resilience import watchdog as _watchdog
 
 __all__ = ["Executor", "FetchFuture", "global_scope", "scope_guard",
            "fetch_var"]
@@ -333,7 +335,11 @@ class Executor:
         ]
 
         _apply_debug_nans()
-        with self._device_scope():
+        # fault-injection hook (no-op unless a ChaosMonkey is installed);
+        # fires BEFORE the dispatch so donated feed buffers are untouched
+        # when an injected transient error reaches the retry layer
+        _chaos.on_run("executor")
+        with _watchdog.armed("executor"), self._device_scope():
             if iters is not None:
                 # ANY explicit iters (including 1) means "feeds carry a
                 # leading [K] axis, fetches come back stacked [K, ...]" —

@@ -247,15 +247,24 @@ def save_checkpoint(executor, checkpoint_dir=None, max_num_checkpoints=3,
         return
     serial += 1
     cur_dir = _get_serial_dir(serial, checkpoint_dir)
+    # write into a .tmp sibling and commit by rename: a crash mid-save can
+    # only ever leave a .tmp orphan (swept by _lru_delete), never a
+    # half-written checkpoint_<N> that a reader could pick up
+    tmp_dir = cur_dir + ".tmp"
+    if os.path.isdir(tmp_dir):
+        shutil.rmtree(tmp_dir, ignore_errors=True)
     save_vars(
         executor,
-        dirname=cur_dir,
+        dirname=tmp_dir,
         main_program=main_program,
         vars=None,
         predicate=_is_checkpoint_var,
         filename=None,
     )
-    _write_success(cur_dir)
+    _write_success(tmp_dir)
+    _fsync_dir(tmp_dir)
+    os.replace(tmp_dir, cur_dir)
+    _fsync_dir(checkpoint_dir)
     _lru_delete(checkpoint_dir, max_num_checkpoints)
 
 
@@ -306,27 +315,63 @@ def _interval_secs_exceed(dirname, save_interval_secs):
 
 
 def _lru_delete(dirname, max_num_checkpoints=3):
-    """reference io.py:576 — keep newest N checkpoint dirs."""
-    dirs = os.listdir(dirname)
-    serials = []
-    for serial in dirs:
+    """reference io.py:576 — keep newest N COMMITTED checkpoint dirs.
+
+    Only dirs carrying the _SUCCESS marker count toward the retention
+    budget; _SUCCESS-less serial dirs are crash debris (with the atomic
+    rename protocol a committed dir always has its marker) and are
+    removed outright rather than silently eating retention slots. Stale
+    `.tmp` staging dirs are swept too (age-gated so a concurrent writer's
+    in-flight temp dir is left alone)."""
+    committed = []
+    for name in os.listdir(dirname):
+        path = os.path.join(dirname, name)
+        if not os.path.isdir(path):
+            continue
+        if name.endswith(".tmp"):
+            try:
+                stale = (time.time() - os.path.getmtime(path)) > 300
+            except OSError:
+                stale = False
+            if stale:
+                shutil.rmtree(path, ignore_errors=True)
+            continue
         try:
-            serials.append(int(serial.split(CHECKPOINT_SEPARATOR)[-1]))
+            serial = int(name.split(CHECKPOINT_SEPARATOR)[-1])
         except ValueError:
             continue
-    if len(serials) <= max_num_checkpoints:
+        if os.path.isfile(os.path.join(path, SUCCESS_MARK_FILENAME)):
+            committed.append(serial)
+        else:
+            shutil.rmtree(path, ignore_errors=True)
+    if len(committed) <= max_num_checkpoints:
         return
-    serials.sort(reverse=True)
-    for serial in serials[max_num_checkpoints:]:
-        cur_dir = _get_serial_dir(serial, dirname)
-        shutil.rmtree(cur_dir, ignore_errors=True)
+    committed.sort(reverse=True)
+    for serial in committed[max_num_checkpoints:]:
+        shutil.rmtree(_get_serial_dir(serial, dirname), ignore_errors=True)
+
+
+def _fsync_dir(path):
+    """fsync a directory fd so the rename/create is durable (no-op where
+    directory fds aren't a thing)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _write_success(dirname):
-    """reference io.py:595 — atomic completion marker."""
+    """reference io.py:595 — completion marker, fsynced so the marker is
+    on disk before the enclosing dir is renamed into place."""
     with open(os.path.join(dirname, SUCCESS_MARK_FILENAME), "a") as f:
         now = time.ctime()
         f.write(now)
+        f.flush()
+        os.fsync(f.fileno())
 
 
 def _get_latest_checkpoint_serial(checkpoint_dir):

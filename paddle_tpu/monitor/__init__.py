@@ -21,12 +21,14 @@ from .registry import (DEFAULT_MS_BUCKETS, Counter, Gauge, Histogram,
 from .skew import measure_replica_ms, replica_skew
 from .step import (StepRecord, cache_evicted, compile_info, compile_probe,
                    enabled, exposition, fingerprint_of, last_step,
-                   record_compile, registry, reset, step_begin, step_end)
+                   record_compile, registry, reset, restore_steps,
+                   step_begin, step_end, steps_done)
 
 __all__ = [
     # step orchestration
     "enabled", "registry", "exposition", "reset", "step_begin", "step_end",
-    "last_step", "StepRecord", "fingerprint_of",
+    "last_step", "StepRecord", "fingerprint_of", "steps_done",
+    "restore_steps",
     # compile-cache visibility
     "compile_info", "record_compile", "compile_probe", "cache_evicted",
     # replica skew

@@ -35,7 +35,7 @@ from .skew import replica_skew
 __all__ = ["StepRecord", "enabled", "registry", "exposition", "reset",
            "step_begin", "step_end", "last_step", "compile_info",
            "record_compile", "compile_probe", "fingerprint_of",
-           "cache_evicted"]
+           "cache_evicted", "steps_done", "restore_steps"]
 
 flags.define(
     "monitor_hlo_cost", bool, False,
@@ -295,3 +295,16 @@ def last_step():
     with _lock:
         rec = _state["last"]
         return dict(rec) if rec is not None else None
+
+
+def steps_done():
+    """Process-wide completed-step count (rides checkpoint manifests)."""
+    with _lock:
+        return _state["steps"]
+
+
+def restore_steps(n):
+    """Rewind/advance the step counter to a checkpoint's value, so journal
+    step indices stay monotonic across a restore."""
+    with _lock:
+        _state["steps"] = int(n)

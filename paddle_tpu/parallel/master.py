@@ -98,6 +98,7 @@ class MasterService:
         self._registry = {}  # (kind, name) -> (addr, expire_time)
         self._stop = False
         self._init_done = False
+        self._conns = set()  # accepted sockets, closed on stop()
         self._checker = threading.Thread(target=self._timeout_loop,
                                          daemon=True)
         self._checker.start()
@@ -273,6 +274,16 @@ class MasterService:
             self._listener.close()
         except (AttributeError, OSError):
             pass
+        # also drop live connections: a stopped master must go silent, not
+        # keep answering RPCs on old sockets (clients reconnect-with-retry
+        # to the replacement; see MasterClient._call)
+        with self._mu:
+            conns, self._conns = set(self._conns), set()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _accept_loop(self):
         while not self._stop:
@@ -283,6 +294,8 @@ class MasterService:
                 continue
             except OSError:
                 return
+            with self._mu:
+                self._conns.add(conn)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
@@ -323,27 +336,77 @@ class MasterService:
                 _rpc._send_msg(conn, reply)
         except (ConnectionError, EOFError, OSError):
             return
+        finally:
+            with self._mu:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 class MasterClient:
-    """reference go/master/client.go + python v2 master client."""
+    """reference go/master/client.go + python v2 master client.
 
-    def __init__(self, endpoint, connect_timeout=30.0):
-        host, port = endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=connect_timeout)
-        self._sock.settimeout(None)
+    Transport faults (connection reset, broken pipe, a master restart)
+    are retried with exponential backoff: the socket is dropped and a
+    fresh connection dialed per attempt, so a trainer rides out a master
+    restart instead of dying on the first hiccup (the reference client
+    re-dials through its etcd watch the same way). Retried get_task calls
+    are at-least-once — a lease the master granted just before the
+    connection died is simply reclaimed by the lease timeout.
+    """
+
+    def __init__(self, endpoint, connect_timeout=30.0, retry=None):
+        self._endpoint = endpoint
+        self._connect_timeout = float(connect_timeout)
         self._lock = threading.Lock()
+        self._sock = None
+        if retry is None:
+            from ..resilience.retry import RetryPolicy
+
+            retry = RetryPolicy(kind="master_client")
+        self._retry = retry
+        with self._lock:
+            self._connect_locked()  # fail fast when the master is absent
+
+    def _connect_locked(self):
+        host, port = self._endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection(
+            (host, int(port)), timeout=self._connect_timeout)
+        self._sock.settimeout(None)
+
+    def _drop_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def _call(self, *msg):
-        with self._lock:
-            _rpc._send_msg(self._sock, msg)
-            resp = _rpc._recv_msg(self._sock)
-        if resp[0] == "taskerr":
-            raise _ERRS[resp[1]](resp[2])
-        if resp[0] != "ok":
-            raise _rpc.RpcError(str(resp[1:]))
-        return resp[1]
+        from ..resilience.errors import TransientError
+
+        def attempt():
+            with self._lock:
+                try:
+                    if self._sock is None:
+                        self._connect_locked()
+                    _rpc._send_msg(self._sock, msg)
+                    resp = _rpc._recv_msg(self._sock)
+                except (ConnectionError, EOFError, socket.timeout,
+                        OSError) as e:
+                    self._drop_locked()  # next attempt re-dials
+                    raise TransientError(
+                        f"master rpc {msg[0]!r} to {self._endpoint} "
+                        f"failed: {e}") from e
+            if resp[0] == "taskerr":
+                raise _ERRS[resp[1]](resp[2])
+            if resp[0] != "ok":
+                raise _rpc.RpcError(str(resp[1:]))
+            return resp[1]
+
+        return self._retry.call(attempt)
 
     def set_dataset(self, chunks):
         return self._call("set_dataset", list(chunks))
@@ -373,19 +436,19 @@ class MasterClient:
         """Disconnect THIS client; the master keeps serving other trainers
         (a departing trainer must never take the coordination service — and
         every live lease reaper — down with it)."""
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._drop_locked()
 
     def shutdown_service(self):
         """Stop the master service itself (job teardown)."""
-        try:
-            with self._lock:
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._connect_locked()
                 _rpc._send_msg(self._sock, ("exit",))
-        except OSError:
-            pass
-        self._sock.close()
+            except OSError:
+                pass
+            self._drop_locked()
 
 
 def task_iterator(client, pass_id, poll_interval=0.1, max_wait=60.0):

@@ -55,6 +55,16 @@ def dump_crc_blob(path, obj):
         f.flush()
         os.fsync(f.fileno())  # rename-before-data after power loss = torn file
     os.replace(tmp, path)
+    # fsync the parent so the RENAME itself survives power loss (else the
+    # dir entry may still point at the old blob — or nothing — on replay)
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
 
 
 def load_crc_blob(path):

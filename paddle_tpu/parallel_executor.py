@@ -33,6 +33,8 @@ from .core.lod_tensor import LoDTensor
 from .core.registry import SeqTensor
 from .core.scope import global_scope
 from .executor import as_numpy, _apply_debug_nans
+from .resilience import chaos as _chaos
+from .resilience import watchdog as _watchdog
 
 __all__ = ["ParallelExecutor", "ExecutionStrategy", "BuildStrategy"]
 
@@ -341,8 +343,11 @@ class ParallelExecutor:
         else:
             rng = jax.random.fold_in(base_key, self._step)
             self._step += 1
+        # fault-injection hook (no-op without an installed ChaosMonkey),
+        # before the dispatch so donated buffers are intact on a raise
+        _chaos.on_run("parallel_executor")
         tc = time.perf_counter() if mon is not None else None
-        with self._mesh:
+        with _watchdog.armed("parallel_executor"), self._mesh:
             fetches, new_mut = compiled(mut_state, const_state, feed_vals, rng)
         replica_ms = replica_ids = None
         if mon is not None:

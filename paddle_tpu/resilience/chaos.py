@@ -1,0 +1,155 @@
+"""Deterministic fault injection for the resilience test matrix.
+
+A ChaosMonkey holds a list of Faults; `install()` makes it global so the
+executor's `on_run()` hook (one dict lookup when nothing is installed)
+and the runner's step hooks can consult it. Faults are keyed
+deterministically — no RNG — so a test can say "the 3rd device dispatch
+raises UNAVAILABLE, twice" and prove the retry path end to end:
+
+    delay      sleep delay_ms before the dispatch        (keyed on run-call index)
+    transient  raise errors.TransientError               (keyed on run-call index)
+    nan        poison the step's fetched metrics to NaN  (keyed on global step)
+    sigterm    os.kill(self, SIGTERM)                    (keyed on global step)
+
+delay/transient count *executor run calls* because that is what retry
+wraps (a retried step consumes several run-call indices — set `times` to
+cover the attempts you want to fail). nan/sigterm count the runner's
+*global step*, which survives restore.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+
+from .. import monitor
+from .errors import TransientError
+
+__all__ = ["Fault", "ChaosMonkey", "install", "uninstall", "active",
+           "on_run"]
+
+_KINDS = ("delay", "transient", "nan", "sigterm")
+
+
+class Fault:
+    def __init__(self, kind, at, times=1, delay_ms=100.0, label=None):
+        if kind not in _KINDS:
+            raise ValueError(f"kind must be one of {_KINDS}, got {kind!r}")
+        self.kind = kind
+        self.at = int(at)        # run-call index or global step (see kind)
+        self.times = int(times)  # consecutive occurrences from `at`
+        self.delay_ms = float(delay_ms)
+        self.label = label       # None = any executor; else exact match
+        self.fired = 0
+
+    def _covers(self, n):
+        # the fired cap (not just the position window) matters for
+        # step-keyed faults: nan_policy=restore REPLAYS the poisoned step,
+        # and a fault that re-fired on every replay would roll back forever
+        return self.fired < self.times \
+            and self.at <= n < self.at + self.times
+
+    def __repr__(self):
+        return (f"Fault({self.kind!r}, at={self.at}, times={self.times}, "
+                f"label={self.label!r})")
+
+
+class ChaosMonkey:
+    def __init__(self, faults=()):
+        self.faults = list(faults)
+        self.run_calls = 0   # executor dispatches observed
+        self.injected = []   # (kind, key, label) log for assertions
+
+    def add(self, fault):
+        self.faults.append(fault)
+        return self
+
+    def _fire(self, fault, key, label=None):
+        fault.fired += 1
+        self.injected.append((fault.kind, key, label))
+        monitor.registry().counter(
+            "chaos_injections_total",
+            help="faults injected by the chaos harness",
+            kind=fault.kind).inc()
+
+    def on_run(self, label):
+        """Executor hook, called once per device dispatch (before the
+        dispatch, so donated buffers are still intact on raise)."""
+        n = self.run_calls
+        self.run_calls += 1
+        for f in self.faults:
+            if f.label is not None and f.label != label:
+                continue
+            if f.kind == "delay" and f._covers(n):
+                self._fire(f, n, label)
+                time.sleep(f.delay_ms / 1000.0)
+            elif f.kind == "transient" and f._covers(n):
+                self._fire(f, n, label)
+                raise TransientError(
+                    f"chaos: injected transient at run call {n}")
+
+    def on_step(self, step):
+        """Runner hook, called at each global-step boundary (after the
+        step's checkpoint cadence ran)."""
+        for f in self.faults:
+            if f.kind == "sigterm" and f._covers(step):
+                self._fire(f, step)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    def poison(self, step, metrics):
+        """Runner hook: NaN-poison the fetched metrics for step `step`."""
+        for f in self.faults:
+            if f.kind == "nan" and f._covers(step):
+                self._fire(f, step)
+                return _poison_tree(metrics)
+        return metrics
+
+
+def _poison_tree(value):
+    """Copy of `value` with the first float leaf set to NaN."""
+    done = [False]
+
+    def rec(v):
+        if done[0]:
+            return v
+        if isinstance(v, dict):
+            return {k: rec(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return type(v)(rec(x) for x in v)
+        try:
+            arr = np.asarray(v)
+        except Exception:
+            return v
+        if arr.dtype.kind == "f" and not done[0]:
+            done[0] = True
+            arr = np.array(arr, copy=True)
+            arr.flat[0] = np.nan
+            return arr
+        return v
+
+    out = rec(value)
+    return out if done[0] else value
+
+
+_active = [None]
+
+
+def install(monkey):
+    _active[0] = monkey
+    return monkey
+
+
+def uninstall():
+    _active[0] = None
+
+
+def active():
+    return _active[0]
+
+
+def on_run(label):
+    """Module-level executor hook — one list lookup when chaos is off."""
+    m = _active[0]
+    if m is not None:
+        m.on_run(label)

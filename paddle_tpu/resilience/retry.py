@@ -1,0 +1,81 @@
+"""RetryPolicy: bounded exponential backoff + jitter around a step fn.
+
+Wraps `exe.run()` (or any callable touching flaky infrastructure — the
+MasterClient transport reuses it) so transient device/transfer errors are
+retried with exponential backoff while programmer errors surface
+immediately (see errors.is_transient). Every retry lands in the monitor
+registry as `resilience_retries_total` so a fleet dashboard can see a
+link going bad before it goes dark.
+"""
+
+import random
+import time
+
+from .. import flags
+from .. import monitor
+from .errors import is_transient
+
+__all__ = ["RetryPolicy"]
+
+
+class RetryPolicy:
+    """call(fn) runs fn, retrying transient failures.
+
+    max_attempts:  total tries including the first (flag default)
+    base_delay_ms: backoff before retry i is base * 2**i, capped at
+    max_delay_ms:  this ceiling
+    jitter:        each delay is scaled by uniform[1-jitter, 1+jitter]
+                   (decorrelates a fleet retrying in lockstep); the rng is
+                   seeded per-policy so tests are deterministic
+    classify:      exc -> bool (True = transient, retry); default
+                   errors.is_transient
+    sleep:         injectable for tests
+    """
+
+    def __init__(self, max_attempts=None, base_delay_ms=None,
+                 max_delay_ms=None, jitter=0.25, classify=None, sleep=None,
+                 seed=0, kind="executor"):
+        self.max_attempts = int(max_attempts
+                                if max_attempts is not None
+                                else flags.get("resilience_max_attempts"))
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}")
+        self.base_delay_ms = float(
+            base_delay_ms if base_delay_ms is not None
+            else flags.get("resilience_backoff_base_ms"))
+        self.max_delay_ms = float(
+            max_delay_ms if max_delay_ms is not None
+            else flags.get("resilience_backoff_max_ms"))
+        self.jitter = float(jitter)
+        self.classify = classify if classify is not None else is_transient
+        self.sleep = sleep if sleep is not None else time.sleep
+        self._rng = random.Random(seed)
+        self.kind = kind
+        self.last_attempts = 0  # attempts the most recent call() used
+
+    def delay_ms(self, attempt):
+        """Backoff before retry `attempt` (0-based), jittered."""
+        d = min(self.base_delay_ms * (2.0 ** attempt), self.max_delay_ms)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, d)
+
+    def call(self, fn, *args, **kwargs):
+        last = None
+        for attempt in range(self.max_attempts):
+            self.last_attempts = attempt + 1
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:
+                if not self.classify(e):
+                    raise
+                last = e
+                if attempt + 1 >= self.max_attempts:
+                    raise
+                monitor.registry().counter(
+                    "resilience_retries_total",
+                    help="transient step failures retried with backoff",
+                    kind=self.kind).inc()
+                self.sleep(self.delay_ms(attempt) / 1000.0)
+        raise last  # pragma: no cover - loop always returns or raises

@@ -78,10 +78,12 @@ class Trainer:
     """
 
     def __init__(self, train_func, optimizer_func, param_path=None, place=None,
-                 parallel=False, checkpoint_config=None):
+                 parallel=False, checkpoint_config=None,
+                 resilience_config=None):
         self.__stop = False
         self.parallel = parallel
         self.checkpoint_cfg = checkpoint_config
+        self.resilience_cfg = resilience_config
 
         self.scope = Scope()
         self.startup_program = Program()
@@ -120,6 +122,18 @@ class Trainer:
                     Executor(self.place), self.checkpoint_cfg.checkpoint_dir,
                     self.train_program,
                 )
+
+        # fault-tolerant loop (paddle_tpu.resilience): retry/NaN-guard/
+        # preemption handling plus async atomic checkpoints; the actual
+        # restore happens at train() start, where the datapipe (whose
+        # source position rides the manifest) is in hand
+        self._resilience = None
+        if resilience_config is not None:
+            from .resilience import ResilientRunner
+
+            self._resilience = ResilientRunner(
+                resilience_config, scope=self.scope,
+                program=self.train_program, place=self.place)
 
     def _dist_transpile_if_necessary(self, optimize_ops, params_grads):
         """Cluster bootstrap from env (reference trainer.py:148-196)."""
@@ -205,6 +219,10 @@ class Trainer:
         dispatch per iteration (Executor.run iters=K)."""
         exe = Executor(self.place)
         iters = pipe.feed_iters
+        if self._resilience is not None:
+            self._train_by_datapipe_resilient(num_epochs, event_handler,
+                                              pipe, exe, iters)
+            return
         for epoch_id in range(num_epochs):
             event_handler(BeginEpochEvent(epoch_id))
             for step_id, staged in enumerate(pipe):
@@ -226,6 +244,65 @@ class Trainer:
                                            monitor=snap))
             event_handler(EndEpochEvent(epoch_id))
 
+    def _train_by_datapipe_resilient(self, num_epochs, event_handler, pipe,
+                                     exe, iters):
+        """The datapipe loop under a ResilientRunner: restore-at-start
+        (params, step counter, mid-epoch source position), retried step
+        dispatch, NaN guard, checkpoint cadence, grace-save on SIGTERM/
+        SIGINT (which re-raises resilience.Preempted). Step events carry
+        the runner's GLOBAL step id — stable across restores, unlike a
+        per-epoch index."""
+        from .resilience import RolledBack
+
+        runner = self._resilience
+
+        def reseat_rng():
+            # the per-program fold counter is derived state: global_step
+            # dispatches, each folding `iters or 1` keys — reseat it so a
+            # restored run replays the identical rng stream
+            exe._step_counter[id(self.train_program)] = \
+                runner.global_step * (iters or 1)
+
+        with runner.session():
+            runner.restore(pipe)
+            reseat_rng()
+            epoch_id = int(runner.state.get("epoch", 0))
+            while epoch_id < num_epochs:
+                event_handler(BeginEpochEvent(epoch_id))
+                try:
+                    for staged in pipe:
+                        if self.__stop:
+                            pipe.close()
+                            return
+                        begin_event = BeginStepEvent(epoch_id,
+                                                     runner.global_step)
+                        event_handler(begin_event)
+                        fetch = (
+                            [v.name for v in self.train_func_outputs]
+                            if begin_event.fetch_metrics
+                            else []
+                        )
+                        metrics = runner.run_step(
+                            lambda: exe.run(self.train_program, feed=staged,
+                                            fetch_list=fetch, iters=iters))
+                        metrics = runner.after_step(
+                            metrics, pipe=pipe, extra={"epoch": epoch_id})
+                        snap = monitor_mod.last_step() \
+                            if monitor_mod.enabled() else None
+                        event_handler(EndStepEvent(
+                            epoch_id, runner.global_step - 1, metrics,
+                            monitor=snap))
+                except RolledBack:
+                    # scope+pipe rewound to the last checkpoint; re-enter
+                    # the epoch loop from the restored position
+                    epoch_id = int(runner.state.get("epoch", epoch_id))
+                    reseat_rng()
+                    continue
+                event_handler(EndEpochEvent(epoch_id))
+                epoch_id += 1
+                # epoch boundary: the next pass starts at record 0
+                runner.state["epoch"] = epoch_id
+
     def _train_by_executor(self, num_epochs, event_handler, reader, feed_order):
         with self._prog_and_scope_guard():
             if hasattr(reader, "next_feed"):  # datapipe.DataPipe
@@ -244,6 +321,11 @@ class Trainer:
                 run = lambda feed, fetch: exe.run(
                     self.train_program, feed=feed, fetch_list=fetch
                 )
+            runner = self._resilience
+            if runner is not None:
+                self._reader_loop_resilient(num_epochs, event_handler,
+                                            reader, feeder, run, runner)
+                return
             step = 0
             for epoch_id in range(num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
@@ -275,6 +357,46 @@ class Trainer:
                             self.train_program,
                         )
                 event_handler(EndEpochEvent(epoch_id))
+
+    def _reader_loop_resilient(self, num_epochs, event_handler, reader,
+                               feeder, run, runner):
+        """Reader path under a ResilientRunner. A plain reader has no
+        seekable source position, so restore resumes params + step counter
+        but replays the current epoch's records from its start (use a
+        datapipe for exact mid-epoch resume); a nan_policy=restore
+        rollback likewise restarts the epoch at the checkpoint's params."""
+        from .resilience import RolledBack
+
+        with runner.session():
+            runner.restore()
+            epoch_id = int(runner.state.get("epoch", 0))
+            while epoch_id < num_epochs:
+                event_handler(BeginEpochEvent(epoch_id))
+                try:
+                    for step_id, data in enumerate(reader()):
+                        if self.__stop:
+                            return
+                        begin_event = BeginStepEvent(epoch_id, step_id)
+                        event_handler(begin_event)
+                        fetch = (
+                            [v.name for v in self.train_func_outputs]
+                            if begin_event.fetch_metrics
+                            else []
+                        )
+                        feed = feeder.feed(data)
+                        metrics = runner.run_step(lambda: run(feed, fetch))
+                        metrics = runner.after_step(
+                            metrics, extra={"epoch": epoch_id})
+                        snap = monitor_mod.last_step() \
+                            if monitor_mod.enabled() else None
+                        event_handler(EndStepEvent(epoch_id, step_id,
+                                                   metrics, monitor=snap))
+                except RolledBack:
+                    epoch_id = int(runner.state.get("epoch", epoch_id))
+                    continue
+                event_handler(EndEpochEvent(epoch_id))
+                epoch_id += 1
+                runner.state["epoch"] = epoch_id
 
     def _test_by_executor(self, reader, feed_order, fetch_list):
         with scope_guard(self.scope):

@@ -46,6 +46,67 @@ if [ $? -ne 0 ]; then
     exit 1
 fi
 
+# chaos smoke: a trainer run killed by an injected SIGTERM must grace-save
+# an atomic checkpoint, and a fresh trainer restoring from it must finish
+# with bitwise-identical params to an uninterrupted run — the resilience
+# subsystem's core guarantee, end to end
+JAX_PLATFORMS=cpu python - <<'EOF'
+import shutil, tempfile
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu.resilience import Preempted, chaos
+
+ckpt_dir = tempfile.mkdtemp(prefix="chaos_gate_")
+
+def train_net():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1,
+                           param_attr=fluid.ParamAttr(name="w"),
+                           bias_attr=fluid.ParamAttr(name="b"))
+    return fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+def make_pipe():
+    def reader():
+        rng = np.random.RandomState(7)
+        for _ in range(64):
+            x = rng.rand(4).astype("float32")
+            yield {"x": x, "y": x.sum(keepdims=True).astype("float32")}
+    return fluid.DataPipe.from_reader(reader).batch(4)
+
+def run(cfg, faults=None):
+    if faults:
+        chaos.install(chaos.ChaosMonkey(faults))
+    t = fluid.Trainer(
+        train_func=train_net, place=fluid.CPUPlace(),
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.01),
+        resilience_config=cfg)
+    try:
+        t.train(num_epochs=2, event_handler=lambda e: None,
+                reader=make_pipe())
+    finally:
+        chaos.uninstall()
+    return {n: np.asarray(t.scope.find_var(n)) for n in ("w", "b")}
+
+baseline = run(None)
+cfg = fluid.ResilienceConfig(checkpoint_dir=ckpt_dir, checkpoint_interval=4)
+try:
+    run(cfg, faults=[chaos.Fault("sigterm", at=5)])
+    raise AssertionError("expected Preempted")
+except Preempted:
+    pass
+restored = run(fluid.ResilienceConfig(checkpoint_dir=ckpt_dir,
+                                      checkpoint_interval=4))
+for name, want in baseline.items():
+    assert np.array_equal(want, restored[name]), name
+shutil.rmtree(ckpt_dir, ignore_errors=True)
+print("chaos smoke: ok")
+EOF
+if [ $? -ne 0 ]; then
+    echo "GATE: CHAOS SMOKE RED — do not commit" >&2
+    exit 1
+fi
+
 # bench --dry must emit the MFU-accounting keys the BENCH artifact carries
 dry_out=$(JAX_PLATFORMS=cpu python bench.py --dry | tail -1)
 printf '%s' "$dry_out" | python -c '
