@@ -389,6 +389,105 @@ def measure_pipeline_hostpath(fluid):
     return _run_pipeline(fluid, pipe, warm_chunks, timed_chunks, K)
 
 
+# serving A/B sizing (bench.py --serve): one shared inference MLP, served
+# request-at-a-time (the unbatched floor: every request pays a full
+# dispatch) vs through serve.Server's bucketed batcher.
+SERVE_REQUESTS = int(os.environ.get("BENCH_SERVE_REQUESTS", 512))
+SERVE_MAX_BATCH = int(os.environ.get("BENCH_SERVE_MAX_BATCH", 16))
+# as many concurrent clients as rows in a full batch: enough offered load
+# for the batcher to fill (and immediately flush) the top bucket
+SERVE_CLIENTS = int(
+    os.environ.get("BENCH_SERVE_CLIENTS", SERVE_MAX_BATCH))
+SERVE_FEAT = int(os.environ.get("BENCH_SERVE_FEAT", 64))
+SERVE_HIDDEN = int(os.environ.get("BENCH_SERVE_HIDDEN", 256))
+
+
+def _build_serve_program(fluid):
+    """A small inference MLP: per-dispatch overhead dominates batch-1
+    compute, which is exactly the regime dynamic batching exists for."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[SERVE_FEAT], dtype="float32")
+        h = x
+        for _ in range(3):
+            h = fluid.layers.fc(input=h, size=SERVE_HIDDEN, act="relu")
+        predict = fluid.layers.fc(input=h, size=8, act="softmax")
+    return prog, startup, predict
+
+
+def measure_serve(fluid, place=None, requests=None, max_batch=None,
+                  clients=None, max_wait_ms=2.0):
+    """Serving A/B over ONE program + scope: unbatched QPS (sequential
+    batch-1 exe.run per request — each pays a full dispatch) vs batched QPS
+    (serve.Server: concurrent clients coalesced onto the warmed bucket
+    ladder). Returns the QPS pair, speedup, p50/p95/p99 and the
+    zero-steady-state-compile check."""
+    import threading
+
+    from paddle_tpu import monitor, serve
+
+    requests = SERVE_REQUESTS if requests is None else requests
+    max_batch = SERVE_MAX_BATCH if max_batch is None else max_batch
+    clients = SERVE_CLIENTS if clients is None else clients
+    place = fluid.TPUPlace(0) if place is None else place
+    prog, startup, predict = _build_serve_program(fluid)
+    scope = fluid.Scope()
+    rs = np.random.RandomState(0)
+    examples = rs.rand(requests, SERVE_FEAT).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(place)
+        exe.run(startup)
+
+        # -- unbatched floor: one dispatch per request, serialized --
+        warm = exe.run(prog, feed={"x": examples[:1]}, fetch_list=[predict])
+        assert np.all(np.isfinite(warm[0]))
+        t0 = time.time()
+        for i in range(requests):
+            exe.run(prog, feed={"x": examples[i:i + 1]},
+                    fetch_list=[predict])
+        unbatched_qps = requests / (time.time() - t0)
+
+    # -- batched: the serving engine, concurrent clients --
+    monitor.reset()  # percentiles reflect this timed window only
+    config = serve.ServeConfig(max_batch=max_batch,
+                               max_wait_ms=max_wait_ms,
+                               max_queue_rows=max(requests, max_batch))
+    server = serve.Server(prog, ["x"], [predict], place=place, scope=scope,
+                          config=config)
+    server.start()
+    per = requests // clients
+
+    def client(cid):
+        base = cid * per
+        for i in range(per):
+            server.submit({"x": examples[base + i]}).result()
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    batched_qps = per * clients / (time.time() - t0)
+    stats = server.stats()
+    server.stop()
+    return {
+        "requests": per * clients,
+        "clients": clients,
+        "max_batch": max_batch,
+        "buckets": stats["buckets"],
+        "max_wait_ms": max_wait_ms,
+        "unbatched_qps": round(unbatched_qps, 1),
+        "batched_qps": round(batched_qps, 1),
+        "speedup": round(batched_qps / unbatched_qps, 2),
+        "p50_ms": stats["p50_ms"], "p95_ms": stats["p95_ms"],
+        "p99_ms": stats["p99_ms"],
+        "pad_fraction": round(stats["pad_fraction"], 4),
+        "steady_state_compiles": stats["steady_state_compiles"],
+    }
+
+
 # ResNet-50 at 224x224 is ~4.1 GFLOPs/image forward; training (fwd + bwd)
 # is conventionally ~3x forward. Used only when no HLO cost was captured.
 ANALYTIC_RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.1e9
@@ -475,6 +574,12 @@ def measure_dry(fluid):
         "cache": {k: v for k, v in monitor.registry().snapshot().items()
                   if "compile_cache" in k},
     }
+    # serving mode, CI-sized: the same A/B the full --serve run does
+    # (unbatched vs Server QPS, percentiles, zero-steady-compile check);
+    # runs AFTER the cache snapshot above because it resets the monitor
+    result["serve"] = measure_serve(
+        fluid, place=fluid.CPUPlace(), requests=128, max_batch=8,
+        clients=8)
     print(json.dumps(result))
 
 
@@ -484,6 +589,13 @@ def main():
 
     if "--dry" in sys.argv:
         measure_dry(fluid)
+        return
+
+    if "--serve" in sys.argv:
+        report = measure_serve(fluid)
+        report["metric"] = "serve_batched_qps"
+        report["value"] = report["batched_qps"]
+        print(json.dumps(report))
         return
 
     # telemetry for the BENCH artifact: phase breakdown rides every step,

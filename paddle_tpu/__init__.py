@@ -102,6 +102,7 @@ from . import resilience
 from .resilience import ResilienceConfig, ResilientRunner
 from . import dataset
 from . import parallel
+from . import serve
 from .minibatch import batch
 
 Tensor = LoDTensor
@@ -125,5 +126,5 @@ __all__ = [
     "reader", "dataset", "batch", "unique_name", "parallel", "flags",
     "concurrency", "pipeline", "DeviceChunkFeeder", "datapipe", "DataPipe",
     "AsyncDeviceFeeder", "monitor", "resilience", "ResilienceConfig",
-    "ResilientRunner",
+    "ResilientRunner", "serve",
 ]

@@ -20,6 +20,12 @@ Commands:
                      commit status (committed / incomplete / orphaned
                      .tmp) and show the latest (or chosen) manifest;
                      --json emits the report as JSON.
+  serve --model-dir DIR [--http PORT | --selftest N]
+                     serve a save_inference_model directory with the
+                     batching engine (serve.Server): warm every batch
+                     bucket, then either expose the stdlib HTTP frontend
+                     (POST /v1/infer, GET /healthz /stats /metrics) or
+                     fire N synthetic requests and print stats JSON.
 """
 
 import argparse
@@ -105,6 +111,61 @@ def _cmd_checkpoint(args):
     return 0
 
 
+def _cmd_serve(args):
+    import json
+
+    import numpy as np
+
+    from .core.places import CPUPlace, TPUPlace
+    from .serve import ServeConfig, Server
+    from .serve.http import serve_http
+
+    place = CPUPlace() if args.place == "cpu" else TPUPlace(0)
+    config = ServeConfig(
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        replicas=args.replicas, slo_ms=args.slo_ms,
+        max_queue_rows=args.max_queue_rows)
+    try:
+        server = Server.from_inference_model(
+            args.model_dir, place=place, config=config)
+    except (OSError, ValueError) as e:
+        print(f"cannot load inference model: {e}", file=sys.stderr)
+        return 1
+    server.start()
+    print(f"ready: buckets={list(server.config.buckets)} "
+          f"replicas={config.replicas} "
+          f"warm_compiles={server._warm_entries}", file=sys.stderr)
+    if args.http is not None:
+        print(f"http frontend on {args.host}:{args.http}", file=sys.stderr)
+        serve_http(server, host=args.host, port=args.http)
+        return 0
+    # selftest: synthetic single-example requests from the feed shapes,
+    # a handful of concurrent clients so the batcher actually batches
+    import threading
+
+    n, per = args.selftest, max(1, args.selftest // 8)
+    rng = np.random.RandomState(0)
+
+    def fire(k):
+        for _ in range(k):
+            feed = {name: rng.standard_normal(
+                server._example_shape(name)).astype(
+                server._feed_dtype(name))
+                for name in server.feed_names}
+            server.submit(feed).result()
+
+    threads = [threading.Thread(target=fire, args=(per,))
+               for _ in range(-(-n // per))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stats = server.stats()
+    server.stop()
+    print(json.dumps(stats, indent=2))
+    return 0 if stats["steady_state_compiles"] == 0 else 1
+
+
 def _cmd_train(args):
     env = dict(os.environ)
     env["PADDLE_TRAINING_ROLE"] = args.role.upper()
@@ -142,6 +203,23 @@ def main(argv=None):
     ci.add_argument("--json", action="store_true",
                     help="emit the report as JSON")
 
+    s = sub.add_parser("serve", help="serve a saved inference model with "
+                                     "the batching engine")
+    s.add_argument("--model-dir", required=True,
+                   help="save_inference_model directory")
+    s.add_argument("--place", default="tpu", choices=["tpu", "cpu"])
+    s.add_argument("--max-batch", type=int, default=8)
+    s.add_argument("--max-wait-ms", type=float, default=2.0)
+    s.add_argument("--replicas", type=int, default=1)
+    s.add_argument("--slo-ms", type=float, default=None)
+    s.add_argument("--max-queue-rows", type=int, default=None)
+    s.add_argument("--http", type=int, default=None, metavar="PORT",
+                   help="expose the HTTP frontend on PORT (blocking)")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--selftest", type=int, default=64, metavar="N",
+                   help="without --http: fire N synthetic requests from "
+                        "concurrent clients and print stats JSON")
+
     t = sub.add_parser("train", help="launch a training script with "
                                      "cluster environment")
     t.add_argument("--role", default="trainer",
@@ -165,6 +243,8 @@ def main(argv=None):
             return _cmd_monitor(args)
         if args.command == "checkpoint":
             return _cmd_checkpoint(args)
+        if args.command == "serve":
+            return _cmd_serve(args)
         if args.command == "train":
             return _cmd_train(args)
     except BrokenPipeError:

@@ -262,6 +262,11 @@ class Executor:
         conversion inside run() happens under jax.default_device)."""
         return jax.default_device(jax_device_for(self.place))
 
+    def compile_cache_info(self):
+        """Compile-cache occupancy: {"entries": N}. The serving engine
+        diffs this across warmup to assert zero steady-state compiles."""
+        return {"entries": len(self._compile_cache)}
+
     # ------------------------------------------------------------------
     def run(
         self,

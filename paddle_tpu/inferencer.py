@@ -3,6 +3,7 @@
 import contextlib
 
 from .core.framework import Program, program_guard
+from .core.places import TPUPlace
 from .core.scope import Scope, scope_guard
 from .executor import Executor
 from .parallel_executor import ParallelExecutor
@@ -16,6 +17,7 @@ __all__ = ["Inferencer"]
 class Inferencer:
     def __init__(self, infer_func, param_path, place=None, parallel=False):
         self.param_path = param_path
+        self._infer_func = infer_func
         self.scope = Scope()
         self.parallel = parallel
         self.place = check_and_get_place(place)
@@ -31,8 +33,11 @@ class Inferencer:
 
         if parallel:
             with self._prog_and_scope_guard():
+                # the accelerator flag follows the RESOLVED place: a
+                # CPUPlace inferencer must not grab the TPU mesh
                 self.pe = ParallelExecutor(
-                    use_cuda=True, main_program=self.inference_program
+                    use_tpu=isinstance(self.place, TPUPlace),
+                    main_program=self.inference_program,
                 )
 
     def infer(self, inputs, return_numpy=True):
@@ -52,6 +57,22 @@ class Inferencer:
                     return_numpy=return_numpy,
                 )
         return results
+
+    def serve(self, config=None, transpile=True, start=True):
+        """A serve.Server over this inferencer's program and params.
+
+        The server gets its own Program/Scope (built by from_infer_func
+        from the same infer_func + param_path), so the transpiler's
+        weight folding never mutates the inferencer's own state. With
+        start=True the server comes back warmed and ready."""
+        from .serve import Server
+
+        server = Server.from_infer_func(
+            self._infer_func, self.param_path, place=self.place,
+            config=config, transpile=transpile)
+        if start:
+            server.start()
+        return server
 
     @contextlib.contextmanager
     def _prog_and_scope_guard(self):

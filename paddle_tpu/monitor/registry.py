@@ -159,6 +159,43 @@ class Histogram(_Metric):
                             for b, n in zip(self.buckets, cum)},
             }
 
+    def percentiles(self, *ps):
+        """Estimate percentiles from the bucketed counts: {p: value}.
+
+        Linear interpolation inside the bucket holding the target rank
+        (Prometheus histogram_quantile semantics), with the observed
+        min/max standing in for the open edges (the lower edge of the
+        first occupied bucket, the upper edge of the +Inf bucket) and
+        clamping the estimate — so a one-value histogram reports that
+        value exactly instead of a bucket boundary. Empty histogram ->
+        {p: None}."""
+        for p in ps:
+            if not 0.0 <= float(p) <= 100.0:
+                raise ValueError(f"percentile {p} outside [0, 100]")
+        with self._lock:
+            count = self._count
+            counts = list(self._counts)
+            mn, mx = self._min, self._max
+        if count == 0:
+            return {p: None for p in ps}
+        out = {}
+        for p in ps:
+            rank = float(p) / 100.0 * count
+            acc = 0
+            value = mx
+            for i, c in enumerate(counts):
+                acc += c
+                if c == 0 or acc < rank:
+                    continue
+                lo = self.buckets[i - 1] if i > 0 else mn
+                hi = mx if self.buckets[i] == float("inf") \
+                    else self.buckets[i]
+                frac = (rank - (acc - c)) / c
+                value = lo + frac * (hi - lo)
+                break
+            out[p] = min(max(value, mn), mx)
+        return out
+
 
 class MetricsRegistry:
     """Get-or-create metric store keyed on (name, labels).

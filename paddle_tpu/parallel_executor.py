@@ -120,6 +120,11 @@ class ParallelExecutor:
     def device_count(self):
         return len(self._devices)
 
+    def compile_cache_info(self):
+        """Compile-cache occupancy: {"entries": N}. The serving engine
+        diffs this across warmup to assert zero steady-state compiles."""
+        return {"entries": len(self._compile_cache)}
+
     # ------------------------------------------------------------------
     def _state_sharding(self, name, value):
         """User set_sharding() rules win; else replicated by default, with

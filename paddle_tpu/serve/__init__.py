@@ -1,0 +1,29 @@
+"""paddle_tpu.serve: batched low-latency inference serving.
+
+Wraps an inference Program (ideally after InferenceTranspiler folding)
+behind `Server.submit(feed) -> Future`. A batcher thread coalesces
+concurrent requests, pads them to a fixed bucket ladder so every
+dispatch hits an executable the warmup phase already compiled, and
+round-robins batches across per-device replica executors. Latency
+phases and p50/p95/p99 land in the monitor registry.
+
+    from paddle_tpu import serve
+    server = serve.Server.from_inference_model("model_dir")
+    with server:                       # start() AOT-warms every bucket
+        y, = server.submit({"x": example}).result()
+
+`python -m paddle_tpu serve --model-dir model_dir` runs the same engine
+behind a stdlib HTTP frontend (or a synthetic-load selftest).
+"""
+
+from .buckets import bucket_for, ladder, pad_rows
+from .engine import (SERVE_MS_BUCKETS, ServeConfig, ServeError, Server,
+                     ServerClosed, ServerOverloaded)
+from .http import serve_http
+
+__all__ = [
+    "Server", "ServeConfig", "ServeError", "ServerOverloaded",
+    "ServerClosed", "SERVE_MS_BUCKETS",
+    "ladder", "bucket_for", "pad_rows",
+    "serve_http",
+]

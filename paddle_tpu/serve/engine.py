@@ -1,0 +1,616 @@
+"""Serving engine: dynamic batching onto a bucketed compile cache.
+
+The device wins throughput when every dispatch is (a) large enough to
+amortize the per-dispatch overhead and (b) a shape XLA has already
+compiled. `Server` provides both: `submit(feed)` enqueues one request
+into a thread-safe admission-controlled queue and returns a Future; a
+batcher thread coalesces pending requests up to `max_batch` rows or
+`max_wait_ms`, pads the coalesced batch to the bucket ladder
+(serve/buckets.py), and round-robins the padded batches across replica
+executors — one per accelerator device — whose compile caches were
+AOT-warmed over every bucket before the server reported ready. Workers
+slice each request's rows back out of the batch result and resolve its
+Future, stamping queue/pad/dispatch/readback phase latencies plus
+p50/p95/p99 SLO tracking into the monitor registry.
+
+Zero-steady-state-compile contract: after `start()` returns, dispatches
+of any admissible batch hit an already-compiled executable — asserted
+by `stats()["steady_state_compiles"]` staying 0 (and by the monitor's
+compile_cache_misses counter staying flat). It requires the feed vars'
+non-batch dims to be fully specified (the usual `layers.data` case);
+requests must match those dims exactly.
+"""
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from .. import monitor
+from ..core.framework import Program, Variable
+from ..core.places import CPUPlace, TPUPlace
+from ..core.scope import Scope, scope_guard
+from ..executor import Executor, as_numpy
+from ..trainer import check_and_get_place
+from .buckets import bucket_for, ladder, pad_rows
+
+__all__ = ["ServeConfig", "Server", "ServeError", "ServerOverloaded",
+           "ServerClosed", "SERVE_MS_BUCKETS"]
+
+# serving latencies live well below training-step scale: extend the
+# monitor's default ms ladder downward so sub-ms queue/pad phases and
+# single-digit-ms p50s land in resolving buckets instead of one bin
+SERVE_MS_BUCKETS = (0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 3.0, 5.0, 7.5, 10.0,
+                    15.0, 20.0, 30.0, 50.0, 75.0, 100.0, 200.0, 500.0,
+                    1000.0, 2000.0, 5000.0, float("inf"))
+
+
+class ServeError(RuntimeError):
+    """Base class for serving-engine errors."""
+
+
+class ServerOverloaded(ServeError):
+    """Admission control rejected the request (queue at max_queue_rows)."""
+
+
+class ServerClosed(ServeError):
+    """The server was stopped before (or while) the request was served."""
+
+
+class ServeConfig:
+    """Tuning knobs for one Server.
+
+    max_batch        largest batch (in rows) one dispatch carries; also
+                     the top rung of the bucket ladder.
+    max_wait_ms      how long the batcher holds an underfull batch open
+                     for more requests before flushing it. The knob is
+                     the latency/throughput trade: 0 serves every request
+                     solo (lowest latency, worst QPS), larger values fill
+                     buckets at light load.
+    buckets          explicit bucket ladder (rows); None = powers of two
+                     up to max_batch.
+    max_queue_rows   admission-control bound on queued rows; submit()
+                     raises ServerOverloaded beyond it (bounded
+                     backpressure instead of unbounded latency).
+                     None = 8 * max_batch.
+    replicas         executor replicas the batcher round-robins over, one
+                     per accelerator device (TPUPlace(i)); parameters
+                     are copied to each replica's device at start().
+    dispatch_depth   formed batches allowed in flight per replica before
+                     the batcher blocks (keeps the device queue shallow
+                     while still overlapping host batching with device
+                     compute).
+    slo_ms           latency objective; requests slower than this count
+                     into serve_slo_violations_total. None = untracked.
+    """
+
+    def __init__(self, max_batch=8, max_wait_ms=2.0, buckets=None,
+                 max_queue_rows=None, replicas=1, dispatch_depth=2,
+                 slo_ms=None):
+        self.max_batch = int(max_batch)
+        self.max_wait_ms = float(max_wait_ms)
+        self.buckets = ladder(self.max_batch, buckets)
+        self.max_queue_rows = (8 * self.max_batch if max_queue_rows is None
+                               else int(max_queue_rows))
+        if self.max_queue_rows < self.max_batch:
+            raise ValueError(
+                f"max_queue_rows {self.max_queue_rows} < max_batch "
+                f"{self.max_batch}: the queue could never fill one batch")
+        self.replicas = int(replicas)
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.dispatch_depth = max(1, int(dispatch_depth))
+        self.slo_ms = None if slo_ms is None else float(slo_ms)
+
+
+class _Request:
+    __slots__ = ("feed", "rows", "future", "t_submit", "t_picked")
+
+    def __init__(self, feed, rows):
+        self.feed = feed
+        self.rows = rows
+        self.future = Future()
+        self.t_submit = time.perf_counter()
+        self.t_picked = None
+
+
+class _RequestQueue:
+    """Row-accounted FIFO with non-blocking admission control."""
+
+    def __init__(self, max_rows):
+        self._max_rows = max_rows
+        self._dq = deque()
+        self._rows = 0
+        self._closed = False
+        self._cond = threading.Condition()
+
+    @property
+    def rows(self):
+        with self._cond:
+            return self._rows
+
+    def put(self, req):
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("server is stopped")
+            if self._rows + req.rows > self._max_rows:
+                raise ServerOverloaded(
+                    f"queue at {self._rows}/{self._max_rows} rows; "
+                    f"request of {req.rows} rows rejected")
+            self._dq.append(req)
+            self._rows += req.rows
+            self._cond.notify()
+
+    def get(self, timeout):
+        """Next request, or None on timeout (and on close with an empty
+        queue — the caller checks the stop flag)."""
+        deadline = time.perf_counter() + timeout
+        with self._cond:
+            while not self._dq:
+                remaining = deadline - time.perf_counter()
+                if self._closed or remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            req = self._dq.popleft()
+            self._rows -= req.rows
+            return req
+
+    def close(self):
+        """Stop admitting; hand back whatever is still queued."""
+        with self._cond:
+            self._closed = True
+            drained = list(self._dq)
+            self._dq.clear()
+            self._rows = 0
+            self._cond.notify_all()
+        return drained
+
+
+class _BoundedQueue:
+    """Blocking bounded FIFO for formed batches (stdlib queue.Queue minus
+    the task_done bookkeeping; kept tiny so dispatch depth stays visible)."""
+
+    def __init__(self, depth):
+        self._dq = deque()
+        self._depth = depth
+        self._cond = threading.Condition()
+
+    def put(self, item):
+        with self._cond:
+            while len(self._dq) >= self._depth:
+                self._cond.wait()
+            self._dq.append(item)
+            self._cond.notify_all()
+
+    def get(self):
+        with self._cond:
+            while not self._dq:
+                self._cond.wait()
+            item = self._dq.popleft()
+            self._cond.notify_all()
+            return item
+
+
+class Server:
+    """Batched low-latency inference over a (transpiled) inference Program.
+
+        server = serve.Server(program, feed_names, fetch_list,
+                              place=fluid.TPUPlace(0),
+                              config=serve.ServeConfig(max_batch=16))
+        server.start()                      # AOT-warms every bucket
+        fut = server.submit({"x": one_example})
+        y, = fut.result()
+        server.stop()
+
+    submit() accepts one example (arrays shaped like the feed var minus
+    the batch axis) or a pre-batched group of rows (leading batch axis,
+    up to max_batch); the Future resolves to the fetch list sliced back
+    to exactly the submitted rows.
+    """
+
+    def __init__(self, program, feed_names, fetch_list, place=None,
+                 scope=None, config=None):
+        if not isinstance(program, Program):
+            raise TypeError("program must be a Program")
+        self.program = program
+        self.config = config or ServeConfig()
+        self.place = check_and_get_place(place)
+        self.scope = scope if scope is not None else Scope()
+        self.feed_names = list(feed_names)
+        self.fetch_list = [v if isinstance(v, Variable) else
+                           program.global_block().var(str(v))
+                           for v in fetch_list]
+        gb = program.global_block()
+        self._feed_vars = {}
+        for n in self.feed_names:
+            self._feed_vars[n] = gb.var(n)
+        self._queue = _RequestQueue(self.config.max_queue_rows)
+        self._dispatch_queues = []
+        self._replicas = []       # [(executor, scope)]
+        self._threads = []
+        self._rr = 0
+        self._stop = False
+        self._ready = False
+        self._warm_entries = 0
+        self._lock = threading.Lock()
+
+    # -- construction helpers -------------------------------------------
+    @classmethod
+    def from_inference_model(cls, dirname, place=None, config=None):
+        """Serve a `save_inference_model` directory."""
+        from .. import io as io_mod
+
+        place = check_and_get_place(place)
+        scope = Scope()
+        exe = Executor(place)
+        with scope_guard(scope):
+            program, feed_names, fetch_targets = io_mod.load_inference_model(
+                dirname, exe)
+        return cls(program, feed_names, fetch_targets, place=place,
+                   scope=scope, config=config)
+
+    @classmethod
+    def from_infer_func(cls, infer_func, param_path, place=None,
+                        config=None, transpile=True):
+        """Build the inference program like Inferencer does, load params,
+        and (by default) run the InferenceTranspiler's numeric folding
+        before serving."""
+        from .. import io as io_mod
+        from .. import unique_name
+        from ..core.framework import program_guard
+        from ..transpiler import InferenceTranspiler
+
+        place = check_and_get_place(place)
+        program = Program()
+        with program_guard(program):
+            with unique_name.guard():
+                targets = infer_func()
+        if not isinstance(targets, (list, tuple)):
+            targets = [targets]
+        scope = Scope()
+        exe = Executor(place)
+        with scope_guard(scope):
+            io_mod.load_params(exe, param_path, program)
+        if transpile:
+            InferenceTranspiler().transpile(program, place, scope=scope)
+        gb = program.global_block()
+        feed_names = [n for n, v in gb.vars.items()
+                      if getattr(v, "is_data", False)]
+        return cls(program, feed_names, targets, place=place, scope=scope,
+                   config=config)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self, warm=True):
+        """Build the replicas, AOT-precompile every bucket on each, and
+        start the batcher/worker threads. The server reports ready only
+        after warmup, so the first real request never eats a compile."""
+        with self._lock:
+            if self._threads:
+                raise ServeError("server already started")
+            if self._stop:
+                raise ServerClosed("server was stopped")
+            self._build_replicas()
+            if warm:
+                self._warmup()
+            self._warm_entries = self._cache_entries()
+            for i in range(self.config.replicas):
+                q = _BoundedQueue(self.config.dispatch_depth)
+                self._dispatch_queues.append(q)
+                t = threading.Thread(target=self._worker, args=(i, q),
+                                     name=f"serve-worker-{i}", daemon=True)
+                self._threads.append(t)
+            bt = threading.Thread(target=self._batcher, name="serve-batcher",
+                                  daemon=True)
+            self._threads.append(bt)
+            for t in self._threads:
+                t.start()
+            self._ready = True
+            self._gauge("serve_ready").set(1)
+        return self
+
+    def __enter__(self):
+        if not self._threads:
+            self.start()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        return False
+
+    def ready(self):
+        return self._ready and not self._stop
+
+    def stop(self):
+        """Stop admitting, fail queued/unfinished requests with
+        ServerClosed, and join the threads."""
+        with self._lock:
+            if self._stop:
+                return
+            self._stop = True
+            self._ready = False
+        for req in self._queue.close():
+            req.future.set_exception(ServerClosed("server stopped"))
+        for q in self._dispatch_queues:
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=30.0)
+        self._gauge("serve_ready").set(0)
+
+    def _replica_place(self, i):
+        """Replica i's device: TPUPlace(i) walks the accelerator list (and
+        on an all-CPU host, XLA's virtual host devices); a CPU server
+        keeps every replica on the host place."""
+        if isinstance(self.place, TPUPlace):
+            return type(self.place)(
+                (getattr(self.place, "device_id", 0) + i))
+        return CPUPlace()
+
+    def _build_replicas(self):
+        """Replica 0 serves from the caller's scope; further replicas get
+        a scope holding device-local copies of every persistable var (the
+        round-robin fan-out — each replica owns one device end to end)."""
+        import jax
+
+        from ..core.places import jax_device_for
+
+        persistables = [
+            n for n, v in self.program.global_block().vars.items()
+            if v.persistable and self.scope.find_var(n) is not None]
+        for i in range(self.config.replicas):
+            place = self._replica_place(i)
+            if i == 0:
+                scope = self.scope
+            else:
+                scope = Scope()
+                dev = jax_device_for(place)
+                for n in persistables:
+                    scope.set_var(n, jax.device_put(
+                        np.asarray(self.scope.find_var(n)), dev))
+            self._replicas.append((Executor(place), scope))
+
+    def _warmup(self):
+        """One dummy dispatch per (replica, bucket): every admissible batch
+        shape is compiled before the server reports ready."""
+        t0 = time.perf_counter()
+        for b in self.config.buckets:
+            feed = {n: np.zeros((b,) + self._example_shape(n),
+                                dtype=self._feed_dtype(n))
+                    for n in self.feed_names}
+            for exe, scope in self._replicas:
+                outs = exe.run(self.program, feed=feed,
+                               fetch_list=self.fetch_list, scope=scope,
+                               return_numpy=False)
+                for o in outs:  # fence: the executable must be built NOW
+                    as_numpy(o)
+        self._gauge(
+            "serve_warmup_ms",
+            help="AOT bucket-precompile wall time at server start").set(
+            (time.perf_counter() - t0) * 1000.0)
+
+    # -- request path ---------------------------------------------------
+    def _example_shape(self, name):
+        var = self._feed_vars[name]
+        shape = list(var.shape or [])[1:]
+        return tuple(1 if (d is None or d < 0) else int(d) for d in shape)
+
+    def _feed_dtype(self, name):
+        return self._feed_vars[name].dtype or "float32"
+
+    def _normalize(self, feed):
+        """-> ({name: [rows, ...] array}, rows). A value shaped like the
+        feed var minus its batch axis counts as one row."""
+        if not isinstance(feed, dict):
+            raise ValueError("feed must be a dict of {feed_name: array}")
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"feed missing {missing}")
+        extra = [n for n in feed if n not in self._feed_vars]
+        if extra:
+            raise ValueError(f"unknown feed names {extra}")
+        rows = None
+        out = {}
+        for n in self.feed_names:
+            var = self._feed_vars[n]
+            v = np.asarray(feed[n])
+            rank = len(var.shape or [])
+            if v.ndim == rank - 1:
+                v = v[None, ...]
+            elif v.ndim != rank:
+                raise ValueError(
+                    f"feed {n!r} rank {v.ndim} matches neither one example "
+                    f"(rank {rank - 1}) nor a row batch (rank {rank})")
+            if var.dtype is not None and str(v.dtype) != var.dtype:
+                v = v.astype(var.dtype)
+            if rows is None:
+                rows = v.shape[0]
+            elif v.shape[0] != rows:
+                raise ValueError(
+                    f"feed {n!r} has {v.shape[0]} rows, others have {rows}")
+            out[n] = v
+        if rows is None or rows < 1:
+            raise ValueError("empty request")
+        if rows > self.config.max_batch:
+            raise ValueError(
+                f"request of {rows} rows exceeds max_batch "
+                f"{self.config.max_batch}; split it client-side")
+        return out, rows
+
+    def submit(self, feed):
+        """Enqueue one request; returns a concurrent.futures.Future that
+        resolves to the fetch-list arrays sliced to the request's rows.
+        Raises ServerOverloaded beyond max_queue_rows (bounded
+        backpressure) and ServerClosed after stop()."""
+        if self._stop:
+            raise ServerClosed("server is stopped")
+        if not self._ready:
+            raise ServeError("server not started (call start() first)")
+        vals, rows = self._normalize(feed)
+        req = _Request(vals, rows)
+        reg = monitor.registry()
+        try:
+            self._queue.put(req)
+        except ServerOverloaded:
+            reg.counter("serve_rejected_total",
+                        help="requests rejected by admission control").inc()
+            raise
+        reg.counter("serve_requests_total",
+                    help="requests admitted to the serve queue").inc()
+        self._gauge("serve_queue_rows",
+                    help="rows currently queued").set(self._queue.rows)
+        return req.future
+
+    def infer(self, feed, timeout=None):
+        """Blocking convenience: submit + result."""
+        return self.submit(feed).result(timeout=timeout)
+
+    # -- batcher / workers ----------------------------------------------
+    def _batcher(self):
+        held = None
+        while True:
+            req = held if held is not None else self._queue.get(timeout=0.05)
+            held = None
+            if req is None:
+                if self._stop:
+                    return
+                continue
+            req.t_picked = time.perf_counter()
+            batch, rows = [req], req.rows
+            deadline = req.t_picked + self.config.max_wait_ms / 1000.0
+            while rows < self.config.max_batch and not self._stop:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                nxt = self._queue.get(timeout=remaining)
+                if nxt is None:
+                    break
+                nxt.t_picked = time.perf_counter()
+                if rows + nxt.rows > self.config.max_batch:
+                    held = nxt  # opens the NEXT batch
+                    break
+                batch.append(nxt)
+                rows += nxt.rows
+            self._flush(batch, rows)
+        # unreachable; stop() drains the queue
+
+    def _flush(self, batch, rows):
+        t0 = time.perf_counter()
+        bucket = bucket_for(rows, self.config.buckets)
+        feed = {}
+        for n in self.feed_names:
+            parts = [r.feed[n] for r in batch]
+            feed[n] = parts[0] if len(parts) == 1 else \
+                np.concatenate(parts, axis=0)
+        feed = pad_rows(feed, rows, bucket)
+        pad_s = time.perf_counter() - t0
+        reg = monitor.registry()
+        reg.counter("serve_batches_total", help="batches dispatched",
+                    bucket=str(bucket)).inc()
+        reg.counter("serve_rows_total", help="request rows served").inc(rows)
+        reg.counter("serve_padded_rows_total",
+                    help="ladder padding rows dispatched").inc(bucket - rows)
+        reg.histogram("serve_batch_rows", help="rows per dispatched batch",
+                      buckets=self.config.buckets).observe(rows)
+        if self._stop:
+            for r in batch:
+                r.future.set_exception(ServerClosed("server stopped"))
+            return
+        q = self._dispatch_queues[self._rr]
+        self._rr = (self._rr + 1) % len(self._dispatch_queues)
+        q.put((batch, feed, bucket, rows, pad_s))
+
+    def _worker(self, idx, q):
+        exe, scope = self._replicas[idx]
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            batch, feed, bucket, rows, pad_s = item
+            try:
+                t0 = time.perf_counter()
+                outs = exe.run(self.program, feed=feed,
+                               fetch_list=self.fetch_list, scope=scope,
+                               return_numpy=False)
+                dispatch_s = time.perf_counter() - t0
+                t1 = time.perf_counter()
+                host = [np.asarray(as_numpy(o)) for o in outs]
+                readback_s = time.perf_counter() - t1
+            except BaseException as e:  # noqa: BLE001 — fail the futures
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                continue
+            offset = 0
+            done = time.perf_counter()
+            for r in batch:
+                r.future.set_result(
+                    [h[offset:offset + r.rows] for h in host])
+                offset += r.rows
+                self._record_request(r, pad_s, dispatch_s, readback_s,
+                                     done, replica=idx)
+
+    def _gauge(self, name, help=""):
+        return monitor.registry().gauge(name, help=help)
+
+    def _record_request(self, req, pad_s, dispatch_s, readback_s, done,
+                        replica):
+        reg = monitor.registry()
+        total_ms = (done - req.t_submit) * 1000.0
+        queue_ms = ((req.t_picked or req.t_submit) - req.t_submit) * 1000.0
+        reg.histogram("serve_request_ms",
+                      help="submit-to-result request latency",
+                      buckets=SERVE_MS_BUCKETS).observe(total_ms)
+        for phase, ms in (("queue", queue_ms), ("pad", pad_s * 1000.0),
+                          ("dispatch", dispatch_s * 1000.0),
+                          ("readback", readback_s * 1000.0)):
+            reg.histogram("serve_request_phase_ms",
+                          help="per-phase request latency",
+                          buckets=SERVE_MS_BUCKETS,
+                          phase=phase).observe(ms)
+        reg.counter("serve_replica_requests_total",
+                    help="requests served per replica",
+                    replica=str(replica)).inc()
+        slo = self.config.slo_ms
+        if slo is not None and total_ms > slo:
+            reg.counter("serve_slo_violations_total",
+                        help="requests exceeding ServeConfig.slo_ms").inc()
+
+    # -- visibility -----------------------------------------------------
+    def _cache_entries(self):
+        return sum(exe.compile_cache_info()["entries"]
+                   for exe, _ in self._replicas)
+
+    def latency_percentiles(self, *ps):
+        """{p: ms} over all served requests (monitor histogram estimate)."""
+        ps = ps or (50, 95, 99)
+        h = monitor.registry().histogram("serve_request_ms",
+                                         buckets=SERVE_MS_BUCKETS)
+        return h.percentiles(*ps)
+
+    def stats(self):
+        """One scrape of the serving metrics: counts, latency percentiles,
+        SLO violations, and the zero-steady-state-compile check."""
+        reg = monitor.registry()
+        snap = reg.snapshot()
+        pct = self.latency_percentiles(50, 95, 99)
+        rows = snap.get("serve_rows_total", 0)
+        padded = snap.get("serve_padded_rows_total", 0)
+        return {
+            "ready": self.ready(),
+            "replicas": self.config.replicas,
+            "buckets": list(self.config.buckets),
+            "max_wait_ms": self.config.max_wait_ms,
+            "requests": snap.get("serve_requests_total", 0),
+            "rejected": snap.get("serve_rejected_total", 0),
+            "rows": rows,
+            "padded_rows": padded,
+            "pad_fraction": (padded / (rows + padded)) if rows else 0.0,
+            "queue_rows": self._queue.rows,
+            "p50_ms": pct[50], "p95_ms": pct[95], "p99_ms": pct[99],
+            "slo_ms": self.config.slo_ms,
+            "slo_violations": snap.get("serve_slo_violations_total", 0),
+            "compile_entries": self._cache_entries(),
+            "steady_state_compiles":
+                self._cache_entries() - self._warm_entries,
+        }

@@ -1,0 +1,120 @@
+"""Stdlib HTTP frontend for a Server — no framework dependency.
+
+Endpoints:
+    POST /v1/infer   {"inputs": {name: nested-list}}  ->
+                     {"outputs": [nested-list, ...]}  (sliced to the
+                     request's rows; 429 on backpressure rejection,
+                     503 before ready / after stop)
+    GET  /healthz    200 "ok" once warmup finished, 503 otherwise
+    GET  /stats      Server.stats() as JSON
+    GET  /metrics    Prometheus text exposition of the monitor registry
+
+ThreadingHTTPServer gives one thread per connection; each handler
+thread parks on its request's Future, so concurrent connections batch
+together inside the engine exactly like in-process submitters.
+"""
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .. import monitor
+from .engine import ServeError, ServerClosed, ServerOverloaded
+
+__all__ = ["serve_http", "make_http_server"]
+
+
+def _json_feed(payload, server):
+    inputs = payload.get("inputs")
+    if not isinstance(inputs, dict):
+        raise ValueError('body must be {"inputs": {name: array}}')
+    return {n: np.asarray(v, dtype=server._feed_dtype(n))
+            if n in server._feed_vars else np.asarray(v)
+            for n, v in inputs.items()}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the Server instance is attached to the HTTPServer by the factory
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _reply(self, code, body, content_type="application/json"):
+        data = body if isinstance(body, bytes) else body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _reply_json(self, code, obj):
+        self._reply(code, json.dumps(obj))
+
+    def do_GET(self):
+        engine = self.server.engine
+        if self.path == "/healthz":
+            if engine.ready():
+                self._reply(200, "ok\n", content_type="text/plain")
+            else:
+                self._reply(503, "warming\n", content_type="text/plain")
+        elif self.path == "/stats":
+            self._reply_json(200, engine.stats())
+        elif self.path == "/metrics":
+            self._reply(200, monitor.registry().exposition(),
+                        content_type="text/plain; version=0.0.4")
+        else:
+            self._reply_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        engine = self.server.engine
+        if self.path != "/v1/infer":
+            self._reply_json(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            feed = _json_feed(payload, engine)
+            fut = engine.submit(feed)
+        except ServerOverloaded as e:
+            self._reply_json(429, {"error": str(e)})
+            return
+        except ServerClosed as e:
+            self._reply_json(503, {"error": str(e)})
+            return
+        except (ValueError, ServeError) as e:
+            self._reply_json(400, {"error": str(e)})
+            return
+        try:
+            outs = fut.result()
+        except ServerClosed as e:
+            self._reply_json(503, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — surface model errors
+            self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._reply_json(200, {
+            "outputs": [np.asarray(o).tolist() for o in outs]})
+
+
+def make_http_server(engine, host="127.0.0.1", port=8000):
+    """A ThreadingHTTPServer bound to (host, port), serving `engine`.
+    Caller owns serve_forever()/shutdown() (tests run it in a thread)."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.daemon_threads = True
+    httpd.engine = engine
+    return httpd
+
+
+def serve_http(engine, host="127.0.0.1", port=8000):
+    """Blocking frontend: serve until KeyboardInterrupt, then stop both."""
+    httpd = make_http_server(engine, host, port)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        engine.stop()

@@ -20,44 +20,54 @@ class InferenceTranspiler:
         self.fuse_batch_norm(program, place, scope)
 
     def fuse_batch_norm(self, program, place, scope):
-        """Fold y = bn(conv(x, W) + b_conv) into y = conv(x, W') + b'."""
+        """Fold y = bn(conv(x, W) [+ b_conv]) into y = conv(x, W') + b'.
+
+        Both patterns fold:
+          conv2d -> elementwise_add(bias) -> batch_norm
+              the bias add survives with a folded bias value and its
+              output rewired to the bn's Y (the conv op is untouched);
+          conv2d -> batch_norm   (conv built with bias_attr=False)
+              a fused bias var is created and an elementwise_add is
+              inserted after the conv, writing straight into the bn's Y.
+        In both cases the batch_norm op is dropped and the conv filter is
+        rescaled per output channel in the scope."""
         self.scope = scope
         self.block = program.global_block()
         i = 0
         while i < len(self.block.ops) - 1:
             current_op = self.block.ops[i]
-            if current_op.type in ["conv2d"]:
-                next_i = i + 1
-                next_op = self.block.ops[next_i]
-                bias_op = None
-                if (
-                    next_op.type == "elementwise_add"
-                    and next_i + 1 < len(self.block.ops)
-                    and self.block.ops[next_i + 1].type == "batch_norm"
-                ):
-                    bias_op = next_op
-                    bn_op = self.block.ops[next_i + 1]
-                    bn_idx = next_i + 1
-                elif next_op.type == "batch_norm":
-                    bn_op = next_op
-                    bn_idx = next_i
-                else:
-                    i += 1
-                    continue
-                if not bn_op.attrs.get("is_test", False):
-                    i += 1
-                    continue
-                fused = self._fuse_param(current_op, bn_op, bias_op)
-                if fused:
-                    # rewire conv output to bn output var, drop bn (and bias) op
-                    out_name = bn_op.output("Y")[0]
-                    current_op.outputs["Output"] = [out_name]
-                    del self.block.ops[bn_idx]
-                    if bias_op is not None:
-                        self.block.ops.remove(bias_op)
-                    program._mutation += 1
+            if current_op.type != "conv2d":
+                i += 1
+                continue
+            next_op = self.block.ops[i + 1]
+            bias_op = None
+            if (
+                next_op.type == "elementwise_add"
+                and i + 2 < len(self.block.ops)
+                and self.block.ops[i + 2].type == "batch_norm"
+            ):
+                bias_op = next_op
+                bn_op = self.block.ops[i + 2]
+            elif next_op.type == "batch_norm":
+                bn_op = next_op
+            else:
+                i += 1
+                continue
+            if not bn_op.attrs.get("is_test", False):
+                i += 1
+                continue
+            if self._fuse_param(current_op, bn_op, bias_op):
+                self.block.ops.remove(bn_op)
+                program._mutation += 1
             i += 1
         self._remove_unused_var(program)
+
+    def _channel_axis(self, conv_op, bn_op):
+        """The bias-broadcast axis for this conv's activations (filters are
+        OIHW in both layouts, activations follow data_format)."""
+        layout = conv_op.attrs.get(
+            "data_format", bn_op.attrs.get("data_layout", "NCHW"))
+        return 3 if layout == "NHWC" else 1
 
     def _fuse_param(self, conv_op, bn_op, bias_op):
         def _load(name):
@@ -76,15 +86,22 @@ class InferenceTranspiler:
         inv_std = 1.0 / np.sqrt(var + eps)
         alpha = scale * inv_std  # per-out-channel
         w_new = w * alpha.reshape(-1, 1, 1, 1)
+        out_name = bn_op.output("Y")[0]
         if bias_op is not None:
+            # bn(conv + b) = conv' + b': fold into the EXISTING bias add
+            # and point its output at the bn's Y — the add must survive
+            # (dropping it would lose the bias term entirely)
             b_name = bias_op.input("Y")[0]
             b = _load(b_name)
-            b_new = (b + (0 - mean)) * alpha + bias if b is not None else bias - mean * alpha
+            b_new = (b - mean) * alpha + bias if b is not None \
+                else bias - mean * alpha
             self.scope.set_var(b_name, b_new.astype(np.float32))
-            # keep bias add, re-point it after conv: handled by caller rewiring
+            bias_op.outputs["Out"] = [out_name]
         else:
-            # fold bias into a new elementwise_add after conv? reference adds
-            # bias var; here we bake it into a bias parameter on the conv
+            # biasless conv (bias_attr=False): materialize the fused bias
+            # and add it AFTER the conv, writing straight into the bn's Y
+            # (the conv keeps its own output var — rewiring the conv while
+            # the add reads its old name would orphan the add's input)
             b_new = bias - mean * alpha
             bias_name = w_name + "@bn_fused_bias"
             self.scope.set_var(bias_name, b_new.astype(np.float32))
@@ -92,14 +109,14 @@ class InferenceTranspiler:
                 name=bias_name, shape=(b_new.shape[0],), dtype="float32",
                 persistable=True,
             )
-            out_name = conv_op.output("Output")[0]
+            conv_out = conv_op.output("Output")[0]
             idx = self.block.ops.index(conv_op)
             self.block.insert_op(
                 idx + 1,
                 "elementwise_add",
-                {"X": [out_name], "Y": [bias_name]},
+                {"X": [conv_out], "Y": [bias_name]},
                 {"Out": [out_name]},
-                {"axis": 1},
+                {"axis": self._channel_axis(conv_op, bn_op)},
             )
         self.scope.set_var(w_name, w_new.astype(np.float32))
         return True

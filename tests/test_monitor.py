@@ -79,6 +79,43 @@ def test_registry_counter_gauge_histogram():
     assert reg.snapshot() == {}
 
 
+def test_histogram_percentiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms", buckets=(1.0, 10.0, 100.0))
+
+    # empty histogram: None per requested percentile
+    assert h.percentiles(50, 99) == {50: None, 99: None}
+
+    # one value: reported exactly (min/max clamp), not a bucket edge
+    h.observe(7.0)
+    assert h.percentiles(50) == {50: 7.0}
+
+    # uniform fill of one bucket: linear interpolation inside it
+    h2 = reg.histogram("lat2_ms", buckets=(0.0, 100.0))
+    for v in range(1, 101):  # 1..100, all in the (0, 100] bucket
+        h2.observe(float(v))
+    pct = h2.percentiles(50, 95, 99)
+    assert pct[50] == pytest.approx(50.0, abs=1.0)
+    assert pct[95] == pytest.approx(95.0, abs=1.0)
+    assert pct[99] == pytest.approx(99.0, abs=1.0)
+    assert pct[50] <= pct[95] <= pct[99]
+
+    # the +Inf bucket's open upper edge is the observed max
+    h3 = reg.histogram("lat3_ms", buckets=(1.0,))
+    for v in (0.5, 5.0, 9.0):
+        h3.observe(v)
+    p = h3.percentiles(100)[100]
+    assert p == 9.0
+
+    # estimates never leave [min, max]
+    assert h3.percentiles(0)[0] >= 0.5
+
+    with pytest.raises(ValueError):
+        h.percentiles(101)
+    with pytest.raises(ValueError):
+        h.percentiles(-1)
+
+
 def test_registry_exposition_format():
     reg = MetricsRegistry()
     reg.counter("steps_total", help="steps run", kind="executor").inc(2)

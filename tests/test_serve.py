@@ -1,0 +1,464 @@
+"""paddle_tpu.serve: bucket ladder math, dynamic batching semantics
+(coalescing, max_wait flush, admission control), warmup's
+zero-steady-state-compile contract, multi-replica dispatch, the HTTP
+frontend, and the satellite fixes that ride with the subsystem (conv+bn
+folding numeric equivalence, Inferencer parallel-place regression)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags, monitor, serve
+from paddle_tpu.serve.buckets import bucket_for, ladder, pad_rows
+from paddle_tpu.serve.http import make_http_server
+
+
+@pytest.fixture(autouse=True)
+def _fresh_monitor():
+    monitor.reset()
+    yield
+    monitor.reset()
+
+
+def _fc_server(max_batch=4, replicas=1, feat=4, out=3, **cfg):
+    """A started Server over a tiny fc program, plus the (exe, scope,
+    prog, fetch) needed to compute reference results."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[feat], dtype="float32")
+        y = fluid.layers.fc(input=x, size=out)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    server = serve.Server(
+        prog, ["x"], [y], place=fluid.CPUPlace(), scope=scope,
+        config=serve.ServeConfig(max_batch=max_batch, replicas=replicas,
+                                 **cfg))
+    return server, exe, scope, prog, y
+
+
+def _ref(exe, scope, prog, y, batch):
+    with fluid.scope_guard(scope):
+        return exe.run(prog, feed={"x": batch}, fetch_list=[y])[0]
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder
+# ---------------------------------------------------------------------------
+
+def test_ladder_powers_of_two():
+    assert ladder(8) == (1, 2, 4, 8)
+    assert ladder(1) == (1,)
+    # a non-power-of-two max becomes the top rung
+    assert ladder(6) == (1, 2, 4, 6)
+
+
+def test_ladder_explicit_and_errors():
+    assert ladder(8, [4, 1]) == (1, 4, 8)  # sorted, max appended
+    with pytest.raises(ValueError):
+        ladder(0)
+    with pytest.raises(ValueError):
+        ladder(8, [0, 4])
+    with pytest.raises(ValueError):
+        ladder(8, [16])
+
+
+def test_bucket_for():
+    rungs = ladder(8)
+    assert [bucket_for(r, rungs) for r in (1, 2, 3, 5, 8)] == \
+        [1, 2, 4, 8, 8]
+    assert bucket_for(9, rungs) is None
+
+
+def test_pad_rows_round_trip():
+    feed = {"x": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "y": np.arange(3, dtype=np.int32)}
+    padded = pad_rows(feed, 3, 8)
+    for name in feed:
+        assert padded[name].shape[0] == 8
+        # original rows intact, padding zero
+        np.testing.assert_array_equal(padded[name][:3], feed[name])
+        assert not padded[name][3:].any()
+    # bucket == rows: same dict back, no copy
+    assert pad_rows(feed, 3, 3) is feed
+    with pytest.raises(ValueError):
+        pad_rows(feed, 3, 2)
+    with pytest.raises(ValueError):
+        pad_rows(feed, 4, 8)  # leading axis mismatch
+
+
+# ---------------------------------------------------------------------------
+# engine semantics
+# ---------------------------------------------------------------------------
+
+def test_single_and_batched_requests_match_reference():
+    server, exe, scope, prog, y = _fc_server()
+    with server:
+        one = np.arange(4, dtype=np.float32)
+        out, = server.submit({"x": one}).result(timeout=30)
+        assert out.shape == (1, 3)
+        np.testing.assert_allclose(
+            out, _ref(exe, scope, prog, y, one[None]), rtol=1e-5)
+
+        batch = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        out3, = server.submit({"x": batch}).result(timeout=30)
+        assert out3.shape == (3, 3)  # sliced back from the padded bucket
+        np.testing.assert_allclose(
+            out3, _ref(exe, scope, prog, y, batch), rtol=1e-5)
+
+
+def test_max_wait_ms_flushes_underfull_batch():
+    # one lone request never fills a bucket; the deadline must flush it
+    server, *_ = _fc_server(max_wait_ms=30.0)
+    with server:
+        t0 = time.perf_counter()
+        server.submit({"x": np.zeros(4, np.float32)}).result(timeout=30)
+        elapsed = time.perf_counter() - t0
+    assert elapsed < 10.0  # deadline (30 ms) flushed it, not a hang
+    snap = monitor.registry().snapshot()
+    assert snap.get('serve_batches_total{bucket="1"}', 0) == 1
+
+
+def test_full_bucket_flushes_before_deadline():
+    # offered load == max_batch: the batcher must NOT sit out max_wait_ms
+    server, exe, scope, prog, y = _fc_server(
+        max_batch=4, max_wait_ms=5_000.0)
+    with server:
+        futs = [server.submit({"x": np.full(4, float(i), np.float32)})
+                for i in range(4)]
+        t0 = time.perf_counter()
+        outs = [f.result(timeout=30) for f in futs]
+        assert time.perf_counter() - t0 < 30.0  # << the 5 s deadline
+    for i, (out,) in enumerate(outs):
+        np.testing.assert_allclose(
+            out, _ref(exe, scope, prog, y,
+                      np.full((1, 4), float(i), np.float32)), rtol=1e-5)
+
+
+def test_backpressure_rejects_beyond_max_queue_rows():
+    # white-box: mark ready without starting the batcher, so the queue
+    # deterministically fills instead of racing the drain
+    server, *_ = _fc_server(max_batch=4, max_queue_rows=8)
+    server._ready = True
+    feed = {"x": np.zeros((4, 4), np.float32)}
+    server.submit(feed)
+    server.submit(feed)  # queue now at 8/8 rows
+    with pytest.raises(serve.ServerOverloaded):
+        server.submit(feed)
+    snap = monitor.registry().snapshot()
+    assert snap["serve_rejected_total"] == 1
+    assert snap["serve_requests_total"] == 2
+    server.stop()
+
+
+def test_request_validation():
+    server, *_ = _fc_server(max_batch=4)
+    with server:
+        with pytest.raises(ValueError):  # oversize must split client-side
+            server.submit({"x": np.zeros((5, 4), np.float32)})
+        with pytest.raises(ValueError):  # rank matches neither form
+            server.submit({"x": np.zeros((1, 1, 4), np.float32)})
+        with pytest.raises(ValueError):  # missing feed
+            server.submit({})
+        with pytest.raises(ValueError):  # unknown name
+            server.submit({"x": np.zeros(4, np.float32),
+                           "bogus": np.zeros(1)})
+
+
+def test_submit_before_start_and_after_stop():
+    server, *_ = _fc_server()
+    with pytest.raises(serve.ServeError):
+        server.submit({"x": np.zeros(4, np.float32)})
+    server.start()
+    server.stop()
+    with pytest.raises(serve.ServerClosed):
+        server.submit({"x": np.zeros(4, np.float32)})
+
+
+def test_warmup_precompiles_every_bucket_no_steady_state_misses():
+    flags.set("monitor", True)
+    try:
+        server, *_ = _fc_server(max_batch=4)
+        server.start()
+        # warmup compiled one executable per bucket
+        assert server._warm_entries == len(server.config.buckets) == 3
+        misses_after_warm = monitor.registry().counter(
+            "compile_cache_misses_total", cache="executor").value
+        # every admissible request size, twice over
+        for rows in (1, 2, 3, 4, 1, 2, 3, 4):
+            server.submit(
+                {"x": np.zeros((rows, 4), np.float32)}).result(timeout=30)
+        misses_now = monitor.registry().counter(
+            "compile_cache_misses_total", cache="executor").value
+        assert misses_now == misses_after_warm  # flat: zero new compiles
+        stats = server.stats()
+        assert stats["steady_state_compiles"] == 0
+        server.stop()
+    finally:
+        flags.set("monitor", False)
+
+
+def test_concurrent_clients_get_their_own_rows():
+    server, exe, scope, prog, y = _fc_server(max_batch=8, max_wait_ms=2.0)
+    results = {}
+    with server:
+        def client(i):
+            v = np.full((4,), float(i), dtype=np.float32)
+            out, = server.submit({"x": v}).result(timeout=60)
+            results[i] = out
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert len(results) == 24
+    for i in range(24):
+        want = _ref(exe, scope, prog, y,
+                    np.full((1, 4), float(i), np.float32))
+        np.testing.assert_allclose(results[i], want, rtol=1e-5)
+    # coalescing actually happened: fewer batches than requests
+    snap = monitor.registry().snapshot()
+    batches = sum(v for k, v in snap.items()
+                  if k.startswith("serve_batches_total"))
+    assert batches < 24
+    assert snap["serve_rows_total"] == 24
+
+
+def test_multi_replica_round_robin():
+    server, exe, scope, prog, y = _fc_server(max_batch=2, replicas=2)
+    with server:
+        # sequential submits -> one batch each -> strict replica alternation
+        for i in range(4):
+            v = np.full((4,), float(i), dtype=np.float32)
+            out, = server.submit({"x": v}).result(timeout=30)
+            np.testing.assert_allclose(
+                out, _ref(exe, scope, prog, y, v[None]), rtol=1e-5)
+    snap = monitor.registry().snapshot()
+    assert snap['serve_replica_requests_total{replica="0"}'] == 2
+    assert snap['serve_replica_requests_total{replica="1"}'] == 2
+
+
+def test_stop_fails_queued_requests():
+    server, *_ = _fc_server(max_batch=4, max_queue_rows=8)
+    server._ready = True  # queue without a batcher draining
+    fut = server.submit({"x": np.zeros(4, np.float32)})
+    server.stop()
+    with pytest.raises(serve.ServerClosed):
+        fut.result(timeout=5)
+
+
+def test_stats_and_percentiles_shape():
+    server, *_ = _fc_server()
+    with server:
+        for _ in range(5):
+            server.submit({"x": np.zeros(4, np.float32)}).result(timeout=30)
+        stats = server.stats()
+    assert stats["requests"] == 5
+    for key in ("p50_ms", "p95_ms", "p99_ms"):
+        assert stats[key] is not None and stats[key] >= 0.0
+    assert stats["p50_ms"] <= stats["p95_ms"] <= stats["p99_ms"]
+    pct = server.latency_percentiles(50, 99)
+    assert set(pct) == {50, 99}
+
+
+def test_from_inference_model_factory(tmp_path):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with fluid.program_guard(prog, startup):
+        fluid.io.save_inference_model(str(tmp_path), ["x"], [y], exe)
+    ref = exe.run(prog, feed={"x": np.ones((1, 4), np.float32)},
+                  fetch_list=[y])[0]
+
+    server = serve.Server.from_inference_model(
+        str(tmp_path), place=fluid.CPUPlace())
+    with server:
+        out, = server.submit({"x": np.ones(4, np.float32)}).result(
+            timeout=30)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# HTTP frontend
+# ---------------------------------------------------------------------------
+
+def test_http_frontend_round_trip():
+    server, exe, scope, prog, y = _fc_server()
+    with server:
+        httpd = make_http_server(server, port=0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz") as r:
+                assert r.status == 200
+            body = json.dumps(
+                {"inputs": {"x": [1.0, 2.0, 3.0, 4.0]}}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/infer", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                out = np.asarray(json.loads(r.read())["outputs"][0])
+            want = _ref(exe, scope, prog, y,
+                        np.array([[1.0, 2.0, 3.0, 4.0]], np.float32))
+            np.testing.assert_allclose(out, want, rtol=1e-5)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/stats") as r:
+                stats = json.loads(r.read())
+            assert stats["requests"] >= 1
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as r:
+                assert b"serve_request_ms" in r.read()
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: conv+bn folding (InferenceTranspiler) numeric equivalence
+# ---------------------------------------------------------------------------
+
+def _conv_bn_program(layout, with_bias):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        shape = [8, 8, 3] if layout == "NHWC" else [3, 8, 8]
+        img = fluid.layers.data(name="img", shape=shape, dtype="float32")
+        conv = fluid.layers.conv2d(
+            input=img, num_filters=4, filter_size=3, padding=1,
+            data_format=layout, bias_attr=None if with_bias else False)
+        out = fluid.layers.batch_norm(
+            conv, is_test=True, data_layout=layout)
+    return prog, startup, out
+
+
+def _randomize_persistables(prog, scope, rng):
+    # bn's Variance input must stay positive (it feeds a sqrt); the var is
+    # named like any parameter (batch_norm_0.w_3), so find it via the op
+    variance_names = set()
+    for op in prog.global_block().ops:
+        if op.type == "batch_norm":
+            variance_names.update(op.input("Variance"))
+    for name, var in prog.global_block().vars.items():
+        if not var.persistable or scope.find_var(name) is None:
+            continue
+        cur = np.array(scope.find_var(name), dtype=np.float32)
+        if name in variance_names:
+            scope.set_var(name, rng.uniform(0.5, 2.0, cur.shape)
+                          .astype(np.float32))
+        else:
+            scope.set_var(name, rng.standard_normal(cur.shape)
+                          .astype(np.float32))
+
+
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+@pytest.mark.parametrize("with_bias", [True, False],
+                         ids=["bias", "no_bias"])
+def test_fuse_batch_norm_numeric_equivalence(layout, with_bias):
+    prog, startup, out = _conv_bn_program(layout, with_bias)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rng = np.random.RandomState(7)
+        _randomize_persistables(prog, scope, rng)
+        shape = (2, 8, 8, 3) if layout == "NHWC" else (2, 3, 8, 8)
+        feed = {"img": rng.standard_normal(shape).astype(np.float32)}
+        before = exe.run(prog, feed=feed, fetch_list=[out])[0]
+        assert np.all(np.isfinite(before))
+
+        fluid.InferenceTranspiler().transpile(
+            prog, fluid.CPUPlace(), scope=scope)
+        ops = [op.type for op in prog.global_block().ops]
+        assert "batch_norm" not in ops  # folded away
+        # the bias add survives (with-bias) or was materialized (no-bias)
+        assert ops == ["conv2d", "elementwise_add"]
+        after = exe.run(prog, feed=feed, fetch_list=[out])[0]
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+
+
+def test_fuse_batch_norm_skips_training_mode():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                dtype="float32")
+        conv = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3)
+        fluid.layers.batch_norm(conv)  # is_test=False: must NOT fold
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.InferenceTranspiler().transpile(
+            prog, fluid.CPUPlace(), scope=scope)
+    assert "batch_norm" in [op.type for op in prog.global_block().ops]
+
+
+# ---------------------------------------------------------------------------
+# satellite: Inferencer parallel path derives the accel flag from the place
+# ---------------------------------------------------------------------------
+
+def _save_params_for_infer_func(tmp_path):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.fc(input=x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with fluid.program_guard(prog, startup):
+        fluid.io.save_params(exe, str(tmp_path), main_program=prog)
+
+
+def _infer_func():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    return fluid.layers.fc(input=x, size=3)
+
+
+@pytest.mark.parametrize("place,want_tpu", [
+    (fluid.CPUPlace(), False),
+    (fluid.TPUPlace(0), True),
+])
+def test_inferencer_parallel_accel_follows_place(tmp_path, place, want_tpu,
+                                                 monkeypatch):
+    _save_params_for_infer_func(tmp_path)
+    captured = {}
+    real_init = fluid.ParallelExecutor.__init__
+
+    def spy_init(self, *args, **kwargs):
+        captured.update(kwargs)
+        return real_init(self, *args, **kwargs)
+
+    monkeypatch.setattr(fluid.ParallelExecutor, "__init__", spy_init)
+    inferencer = fluid.Inferencer(
+        infer_func=_infer_func, param_path=str(tmp_path), place=place,
+        parallel=True)
+    assert captured.get("use_tpu") is want_tpu
+    # batch divisible by the device count (8 virtual devices under tpu)
+    out = inferencer.infer({"x": np.ones((8, 4), np.float32)})
+    assert np.asarray(out[0]).shape[-1] == 3
+
+
+def test_inferencer_serve_convenience(tmp_path):
+    _save_params_for_infer_func(tmp_path)
+    inferencer = fluid.Inferencer(
+        infer_func=_infer_func, param_path=str(tmp_path),
+        place=fluid.CPUPlace())
+    want = inferencer.infer({"x": np.ones((1, 4), np.float32)})[0]
+    server = inferencer.serve(
+        config=serve.ServeConfig(max_batch=2), start=True)
+    try:
+        got, = server.submit({"x": np.ones(4, np.float32)}).result(
+            timeout=30)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+    finally:
+        server.stop()

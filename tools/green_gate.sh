@@ -107,7 +107,63 @@ if [ $? -ne 0 ]; then
     exit 1
 fi
 
-# bench --dry must emit the MFU-accounting keys the BENCH artifact carries
+# serve smoke: an in-process Server under concurrent clients must record
+# a p99, coalesce requests into batches, and — the engine's core contract —
+# compile NOTHING after warmup (misses counter flat, steady_state == 0)
+JAX_PLATFORMS=cpu python - <<'EOF'
+import threading
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import flags, monitor, serve
+
+flags.set("monitor", True)
+monitor.reset()
+prog, startup = fluid.Program(), fluid.Program()
+with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    y = fluid.layers.fc(input=x, size=4)
+scope = fluid.Scope()
+exe = fluid.Executor(fluid.CPUPlace())
+with fluid.scope_guard(scope):
+    exe.run(startup)
+server = serve.Server(prog, ["x"], [y], place=fluid.CPUPlace(),
+                      scope=scope,
+                      config=serve.ServeConfig(max_batch=8, max_wait_ms=2.0))
+server.start()
+misses0 = monitor.registry().counter(
+    "compile_cache_misses_total", cache="executor").value
+
+def client(i):
+    rng = np.random.RandomState(i)
+    for _ in range(8):
+        out, = server.submit(
+            {"x": rng.rand(8).astype(np.float32)}).result(timeout=60)
+        assert out.shape == (1, 4)
+
+threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+for t in threads: t.start()
+for t in threads: t.join()
+stats = server.stats()
+misses1 = monitor.registry().counter(
+    "compile_cache_misses_total", cache="executor").value
+server.stop()
+assert stats["requests"] == 64, stats
+assert stats["p99_ms"] is not None and stats["p99_ms"] > 0, stats
+assert misses1 == misses0, (misses0, misses1)
+assert stats["steady_state_compiles"] == 0, stats
+snap = monitor.registry().snapshot()
+batches = sum(v for k, v in snap.items()
+              if k.startswith("serve_batches_total"))
+assert batches < 64, batches  # coalescing happened
+print("serve smoke: ok")
+EOF
+if [ $? -ne 0 ]; then
+    echo "GATE: SERVE SMOKE RED — do not commit" >&2
+    exit 1
+fi
+
+# bench --dry must emit the MFU-accounting keys the BENCH artifact carries,
+# plus the serving A/B block (batched vs unbatched QPS with percentiles)
 dry_out=$(JAX_PLATFORMS=cpu python bench.py --dry | tail -1)
 printf '%s' "$dry_out" | python -c '
 import json, sys
@@ -115,6 +171,11 @@ result = json.loads(sys.stdin.read())
 for key in ("mfu", "model_flops_per_step", "step_ms_breakdown"):
     assert key in result, (key, result)
 assert result["step_ms_breakdown"], result
+srv = result["serve"]
+for key in ("unbatched_qps", "batched_qps", "speedup",
+            "p50_ms", "p95_ms", "p99_ms"):
+    assert srv.get(key) is not None, (key, srv)
+assert srv["steady_state_compiles"] == 0, srv
 print("bench --dry: ok")
 '
 if [ $? -ne 0 ]; then
