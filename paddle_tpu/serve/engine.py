@@ -24,7 +24,7 @@ requests must match those dims exactly.
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 
 import numpy as np
 
@@ -45,6 +45,25 @@ __all__ = ["ServeConfig", "Server", "ServeError", "ServerOverloaded",
 SERVE_MS_BUCKETS = (0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 3.0, 5.0, 7.5, 10.0,
                     15.0, 20.0, 30.0, 50.0, 75.0, 100.0, 200.0, 500.0,
                     1000.0, 2000.0, 5000.0, float("inf"))
+
+
+def _resolve(future, result=None, exc=None):
+    """Resolve `future` if still pending; returns whether it was resolved.
+
+    Clients own the Future and may cancel it (a `result(timeout)` caller
+    giving up does exactly that), so a plain set_result/set_exception can
+    raise InvalidStateError — which must never escape into the batcher or
+    a worker thread."""
+    try:
+        if future.done():
+            return False
+        if exc is not None:
+            future.set_exception(exc)
+        else:
+            future.set_result(result)
+        return True
+    except InvalidStateError:
+        return False
 
 
 class ServeError(RuntimeError):
@@ -175,22 +194,44 @@ class _BoundedQueue:
     def __init__(self, depth):
         self._dq = deque()
         self._depth = depth
+        self._closed = False
         self._cond = threading.Condition()
 
     def put(self, item):
         with self._cond:
-            while len(self._dq) >= self._depth:
+            while len(self._dq) >= self._depth and not self._closed:
                 self._cond.wait()
+            if self._closed:
+                raise ServerClosed("dispatch queue closed")
             self._dq.append(item)
             self._cond.notify_all()
 
     def get(self):
+        """Next item; None once the queue is closed AND drained (in-flight
+        batches enqueued before close() are still handed out)."""
         with self._cond:
-            while not self._dq:
+            while not self._dq and not self._closed:
                 self._cond.wait()
+            if not self._dq:
+                return None
             item = self._dq.popleft()
             self._cond.notify_all()
             return item
+
+    def close(self):
+        """Stop accepting items: wakes blocked put() (which then raises
+        ServerClosed) and lets get() return None once empty."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def drain(self):
+        """Pop and return everything still queued (post-join leftovers)."""
+        with self._cond:
+            items = list(self._dq)
+            self._dq.clear()
+            self._cond.notify_all()
+            return items
 
 
 class Server:
@@ -235,6 +276,15 @@ class Server:
         self._ready = False
         self._warm_entries = 0
         self._lock = threading.Lock()
+        # per-server tallies mirrored next to the process-global registry:
+        # the registry series are unlabeled and shared, so stats() and
+        # latency_percentiles() read these to stay correct when several
+        # Servers live in one process
+        self._own = {name: monitor.Counter(name) for name in
+                     ("requests", "rejected", "rows", "padded_rows",
+                      "slo_violations")}
+        self._own_request_ms = monitor.Histogram(
+            "serve_request_ms", buckets=SERVE_MS_BUCKETS)
 
     # -- construction helpers -------------------------------------------
     @classmethod
@@ -323,19 +373,26 @@ class Server:
         return self._ready and not self._stop
 
     def stop(self):
-        """Stop admitting, fail queued/unfinished requests with
-        ServerClosed, and join the threads."""
+        """Stop admitting, fail queued requests with ServerClosed, let
+        already-dispatched batches finish, and join the threads. Any batch
+        a dead or timed-out worker left behind is failed too — no Future
+        handed out by submit() is ever stranded unresolved."""
         with self._lock:
             if self._stop:
                 return
             self._stop = True
             self._ready = False
         for req in self._queue.close():
-            req.future.set_exception(ServerClosed("server stopped"))
+            _resolve(req.future, exc=ServerClosed("server stopped"))
+        # closing wakes a batcher blocked in put() (it fails that batch)
+        # and lets each worker drain its in-flight batches, then exit
         for q in self._dispatch_queues:
-            q.put(None)
+            q.close()
         for t in self._threads:
             t.join(timeout=30.0)
+        for q in self._dispatch_queues:
+            for item in q.drain():
+                self._fail_batch(item[0], ServerClosed("server stopped"))
         self._gauge("serve_ready").set(0)
 
     def _replica_place(self, i):
@@ -452,9 +509,11 @@ class Server:
         try:
             self._queue.put(req)
         except ServerOverloaded:
+            self._own["rejected"].inc()
             reg.counter("serve_rejected_total",
                         help="requests rejected by admission control").inc()
             raise
+        self._own["requests"].inc()
         reg.counter("serve_requests_total",
                     help="requests admitted to the serve queue").inc()
         self._gauge("serve_queue_rows",
@@ -507,18 +566,31 @@ class Server:
         reg = monitor.registry()
         reg.counter("serve_batches_total", help="batches dispatched",
                     bucket=str(bucket)).inc()
+        self._own["rows"].inc(rows)
         reg.counter("serve_rows_total", help="request rows served").inc(rows)
+        self._own["padded_rows"].inc(bucket - rows)
         reg.counter("serve_padded_rows_total",
                     help="ladder padding rows dispatched").inc(bucket - rows)
         reg.histogram("serve_batch_rows", help="rows per dispatched batch",
                       buckets=self.config.buckets).observe(rows)
+        # the batch left the request queue: keep the depth gauge live for
+        # /metrics scrapes, not just high-water marks from submit()
+        self._gauge("serve_queue_rows",
+                    help="rows currently queued").set(self._queue.rows)
         if self._stop:
-            for r in batch:
-                r.future.set_exception(ServerClosed("server stopped"))
+            self._fail_batch(batch, ServerClosed("server stopped"))
             return
         q = self._dispatch_queues[self._rr]
         self._rr = (self._rr + 1) % len(self._dispatch_queues)
-        q.put((batch, feed, bucket, rows, pad_s))
+        try:
+            q.put((batch, feed, bucket, rows, pad_s))
+        except ServerClosed as e:
+            self._fail_batch(batch, e)
+
+    @staticmethod
+    def _fail_batch(batch, exc):
+        for r in batch:
+            _resolve(r.future, exc=exc)
 
     def _worker(self, idx, q):
         exe, scope = self._replicas[idx]
@@ -537,18 +609,21 @@ class Server:
                 host = [np.asarray(as_numpy(o)) for o in outs]
                 readback_s = time.perf_counter() - t1
             except BaseException as e:  # noqa: BLE001 — fail the futures
-                for r in batch:
-                    if not r.future.done():
-                        r.future.set_exception(e)
+                self._fail_batch(batch, e)
                 continue
             offset = 0
             done = time.perf_counter()
-            for r in batch:
-                r.future.set_result(
-                    [h[offset:offset + r.rows] for h in host])
-                offset += r.rows
-                self._record_request(r, pad_s, dispatch_s, readback_s,
-                                     done, replica=idx)
+            try:
+                for r in batch:
+                    res = [h[offset:offset + r.rows] for h in host]
+                    offset += r.rows
+                    # _resolve: a client-cancelled Future (result(timeout)
+                    # expired) must not kill this worker thread
+                    if _resolve(r.future, result=res):
+                        self._record_request(r, pad_s, dispatch_s,
+                                             readback_s, done, replica=idx)
+            except BaseException as e:  # noqa: BLE001 — fail the futures
+                self._fail_batch(batch, e)
 
     def _gauge(self, name, help=""):
         return monitor.registry().gauge(name, help=help)
@@ -558,6 +633,7 @@ class Server:
         reg = monitor.registry()
         total_ms = (done - req.t_submit) * 1000.0
         queue_ms = ((req.t_picked or req.t_submit) - req.t_submit) * 1000.0
+        self._own_request_ms.observe(total_ms)
         reg.histogram("serve_request_ms",
                       help="submit-to-result request latency",
                       buckets=SERVE_MS_BUCKETS).observe(total_ms)
@@ -573,6 +649,7 @@ class Server:
                     replica=str(replica)).inc()
         slo = self.config.slo_ms
         if slo is not None and total_ms > slo:
+            self._own["slo_violations"].inc()
             reg.counter("serve_slo_violations_total",
                         help="requests exceeding ServeConfig.slo_ms").inc()
 
@@ -582,34 +659,33 @@ class Server:
                    for exe, _ in self._replicas)
 
     def latency_percentiles(self, *ps):
-        """{p: ms} over all served requests (monitor histogram estimate)."""
+        """{p: ms} over requests served by THIS server (the registry's
+        serve_request_ms series is shared process-wide)."""
         ps = ps or (50, 95, 99)
-        h = monitor.registry().histogram("serve_request_ms",
-                                         buckets=SERVE_MS_BUCKETS)
-        return h.percentiles(*ps)
+        return self._own_request_ms.percentiles(*ps)
 
     def stats(self):
         """One scrape of the serving metrics: counts, latency percentiles,
-        SLO violations, and the zero-steady-state-compile check."""
-        reg = monitor.registry()
-        snap = reg.snapshot()
+        SLO violations, and the zero-steady-state-compile check. All values
+        are scoped to this server instance, matching compile_entries, even
+        when several Servers share the process-global registry."""
         pct = self.latency_percentiles(50, 95, 99)
-        rows = snap.get("serve_rows_total", 0)
-        padded = snap.get("serve_padded_rows_total", 0)
+        rows = self._own["rows"].value
+        padded = self._own["padded_rows"].value
         return {
             "ready": self.ready(),
             "replicas": self.config.replicas,
             "buckets": list(self.config.buckets),
             "max_wait_ms": self.config.max_wait_ms,
-            "requests": snap.get("serve_requests_total", 0),
-            "rejected": snap.get("serve_rejected_total", 0),
+            "requests": self._own["requests"].value,
+            "rejected": self._own["rejected"].value,
             "rows": rows,
             "padded_rows": padded,
             "pad_fraction": (padded / (rows + padded)) if rows else 0.0,
             "queue_rows": self._queue.rows,
             "p50_ms": pct[50], "p95_ms": pct[95], "p99_ms": pct[99],
             "slo_ms": self.config.slo_ms,
-            "slo_violations": snap.get("serve_slo_violations_total", 0),
+            "slo_violations": self._own["slo_violations"].value,
             "compile_entries": self._cache_entries(),
             "steady_state_compiles":
                 self._cache_entries() - self._warm_entries,

@@ -26,6 +26,8 @@ __all__ = ["serve_http", "make_http_server"]
 
 
 def _json_feed(payload, server):
+    if not isinstance(payload, dict):
+        raise ValueError('body must be a JSON object {"inputs": {...}}')
     inputs = payload.get("inputs")
     if not isinstance(inputs, dict):
         raise ValueError('body must be {"inputs": {name: array}}')

@@ -7,6 +7,7 @@ folding numeric equivalence, Inferencer parallel-place regression)."""
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -14,6 +15,7 @@ import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import flags, monitor, serve
+from paddle_tpu.serve import engine as serve_engine
 from paddle_tpu.serve.buckets import bucket_for, ladder, pad_rows
 from paddle_tpu.serve.http import make_http_server
 
@@ -269,6 +271,85 @@ def test_stats_and_percentiles_shape():
     assert set(pct) == {50, 99}
 
 
+def test_cancelled_future_does_not_kill_worker():
+    # a client that gives up (result(timeout) expired -> Future.cancel())
+    # leaves a CANCELLED future in the batch; the worker must survive it
+    # and still resolve the other requests in the same batch
+    server, exe, scope, prog, y = _fc_server(max_batch=4)
+    server._build_replicas()
+    cancelled = serve_engine._Request(
+        {"x": np.zeros((1, 4), np.float32)}, 1)
+    assert cancelled.future.cancel()
+    live = serve_engine._Request({"x": np.ones((1, 4), np.float32)}, 1)
+    feed = {"x": np.concatenate([cancelled.feed["x"], live.feed["x"]])}
+    q = serve_engine._BoundedQueue(2)
+    q.put(([cancelled, live], feed, 2, 2, 0.0))
+    q.close()
+    server._worker(0, q)  # returns after draining; must not raise
+    out, = live.future.result(timeout=0)
+    np.testing.assert_allclose(
+        out, _ref(exe, scope, prog, y, np.ones((1, 4), np.float32)),
+        rtol=1e-5)
+
+
+def test_bounded_queue_close_unblocks_put_and_drains_get():
+    q = serve_engine._BoundedQueue(1)
+    q.put("a")
+    outcome = []
+
+    def blocked_put():
+        try:
+            q.put("b")
+        except serve.ServerClosed:
+            outcome.append("closed")
+
+    t = threading.Thread(target=blocked_put)
+    t.start()
+    time.sleep(0.05)  # let the put block on the full queue
+    q.close()
+    t.join(timeout=10)
+    assert not t.is_alive() and outcome == ["closed"]
+    assert q.get() == "a"   # pre-close items still drain
+    assert q.get() is None  # then the close is reported
+
+
+def test_stop_fails_batches_left_in_dispatch_queues():
+    # a batch stranded in a dispatch queue (worker gone) must not leave
+    # its futures unresolved after stop()
+    server, *_ = _fc_server()
+    req = serve_engine._Request({"x": np.zeros((1, 4), np.float32)}, 1)
+    q = serve_engine._BoundedQueue(2)
+    q.put(([req], req.feed, 1, 1, 0.0))
+    server._dispatch_queues.append(q)
+    server.stop()
+    with pytest.raises(serve.ServerClosed):
+        req.future.result(timeout=5)
+
+
+def test_two_servers_keep_stats_separate():
+    s1, *_ = _fc_server()
+    s2, *_ = _fc_server()
+    with s1, s2:
+        for _ in range(3):
+            s1.submit({"x": np.zeros(4, np.float32)}).result(timeout=30)
+        s2.submit({"x": np.ones(4, np.float32)}).result(timeout=30)
+        st1, st2 = s1.stats(), s2.stats()
+    assert st1["requests"] == 3 and st1["rows"] == 3
+    assert st2["requests"] == 1 and st2["rows"] == 1
+    assert s1.latency_percentiles(50)[50] is not None
+    # the shared registry still aggregates across both servers
+    assert monitor.registry().snapshot()["serve_requests_total"] == 4
+
+
+def test_queue_rows_gauge_tracks_drain():
+    server, *_ = _fc_server()
+    with server:
+        server.submit({"x": np.zeros(4, np.float32)}).result(timeout=30)
+        # the result resolving implies the batcher flushed the queue; the
+        # gauge must reflect the drained depth, not submit's high water
+        assert monitor.registry().gauge("serve_queue_rows").value == 0
+
+
 def test_from_inference_model_factory(tmp_path):
     prog, startup = fluid.Program(), fluid.Program()
     with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
@@ -321,6 +402,28 @@ def test_http_frontend_round_trip():
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/metrics") as r:
                 assert b"serve_request_ms" in r.read()
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+def test_http_non_object_body_is_400():
+    # valid JSON that is not an object must be a 400, not a dropped
+    # connection from an AttributeError inside the handler
+    server, *_ = _fc_server()
+    with server:
+        httpd = make_http_server(server, port=0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            for body in (b"[1, 2]", b'"x"', b"not json at all"):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/infer", data=body,
+                    headers={"Content-Type": "application/json"})
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req)
+                assert ei.value.code == 400
         finally:
             httpd.shutdown()
             httpd.server_close()
