@@ -574,6 +574,43 @@ def measure_dry(fluid):
         "cache": {k: v for k, v in monitor.registry().snapshot().items()
                   if "compile_cache" in k},
     }
+    # trace overhead A/B: the FLAGS_trace=0 contract says the disabled
+    # hot path costs one flag check, so step time with the flag off must
+    # not move after the tracing code paths have been exercised. Three
+    # timed loops (off/on/off), min-of-3 calls each to shave scheduler
+    # noise; `off_delta_frac` compares the two OFF runs — that is the
+    # <=1% gate green_gate.sh asserts (absolute slack floor because a
+    # sub-ms CPU step makes percentages of timer jitter meaningless).
+    from paddle_tpu import trace as trace_mod
+
+    def timed_loop():
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            exe_run()
+            best = min(best, time.perf_counter() - t0)
+        return best * 1000.0 / K
+
+    with fluid.scope_guard(scope):
+        def exe_run():
+            exe.run(prog, feed=feeds, fetch_list=[loss], iters=K)
+
+        off1_ms = timed_loop()
+        flags.set("trace", True)
+        on_ms = timed_loop()
+        flags.set("trace", False)
+        off2_ms = timed_loop()
+    trace_mod.reset()
+    base = min(off1_ms, off2_ms)
+    delta = (off2_ms - off1_ms) / off1_ms if off1_ms > 0 else 0.0
+    result["trace"] = {
+        "off_step_ms": round(off1_ms, 4),
+        "on_step_ms": round(on_ms, 4),
+        "off2_step_ms": round(off2_ms, 4),
+        "on_overhead_frac": round((on_ms - base) / base, 4) if base else 0.0,
+        "off_delta_frac": round(delta, 4),
+        "off_delta_ok": delta <= 0.01 or abs(off2_ms - off1_ms) <= 0.25,
+    }
     # serving mode, CI-sized: the same A/B the full --serve run does
     # (unbatched vs Server QPS, percentiles, zero-steady-compile check);
     # runs AFTER the cache snapshot above because it resets the monitor

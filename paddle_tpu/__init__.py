@@ -103,6 +103,7 @@ from .resilience import ResilienceConfig, ResilientRunner
 from . import dataset
 from . import parallel
 from . import serve
+from . import trace
 from .minibatch import batch
 
 Tensor = LoDTensor
@@ -126,5 +127,5 @@ __all__ = [
     "reader", "dataset", "batch", "unique_name", "parallel", "flags",
     "concurrency", "pipeline", "DeviceChunkFeeder", "datapipe", "DataPipe",
     "AsyncDeviceFeeder", "monitor", "resilience", "ResilienceConfig",
-    "ResilientRunner", "serve",
+    "ResilientRunner", "serve", "trace",
 ]

@@ -26,6 +26,16 @@ Commands:
                      bucket, then either expose the stdlib HTTP frontend
                      (POST /v1/infer, GET /healthz /stats /metrics) or
                      fire N synthetic requests and print stats JSON.
+  trace ops --model-dir DIR
+                     compile the model once with tracing + HLO cost
+                     analysis on and print the slowest-ops table (HLO
+                     cost attributed back to ProgramDesc ops).
+  trace summary DIR  summarize a flight-recorder dump directory (span
+                     counts per name, traces, slowest spans).
+  trace dump [--out DIR] [--selftest]
+                     dump the in-process flight recorder (--selftest
+                     records synthetic spans first, proving the
+                     record->dump->load path end to end).
 """
 
 import argparse
@@ -166,6 +176,114 @@ def _cmd_serve(args):
     return 0 if stats["steady_state_compiles"] == 0 else 1
 
 
+def _cmd_trace(args):
+    import json
+
+    from . import trace
+
+    if args.trace_action == "ops":
+        import numpy as np
+
+        from . import flags
+        from .core.places import CPUPlace, TPUPlace
+        from .core.scope import Scope, scope_guard
+        from .executor import Executor
+        from .io import load_inference_model
+
+        flags.set("monitor", True)
+        flags.set("monitor_hlo_cost", True)
+        flags.set("trace", True)
+        place = CPUPlace() if args.place == "cpu" else TPUPlace(0)
+        exe = Executor(place)
+        scope = Scope()
+        try:
+            with scope_guard(scope):
+                program, feed_names, fetch_targets = load_inference_model(
+                    args.model_dir, exe)
+        except (OSError, ValueError) as e:
+            print(f"cannot load inference model: {e}", file=sys.stderr)
+            return 1
+        feed = {}
+        for name in feed_names:
+            var = program.global_block().var(name)
+            shape = [args.batch if d is None or d < 0 else d
+                     for d in var.shape]
+            feed[name] = np.zeros(shape, dtype=var.dtype)
+        with scope_guard(scope):
+            exe.run(program, feed=feed, fetch_list=fetch_targets)
+        report = trace.slowest_ops(batch_size=args.batch, top=args.top)
+        if report is None:
+            print("no compile recorded — nothing to attribute",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            print(trace.format_ops_table(report))
+        return 0
+
+    if args.trace_action == "summary":
+        try:
+            loaded = trace.load_dump(args.dir)
+        except (OSError, ValueError) as e:
+            print(f"cannot load dump: {e}", file=sys.stderr)
+            return 1
+        man, spans = loaded["manifest"], loaded["spans"]
+        print(f"dump: {args.dir}")
+        print(f"  reason={man.get('reason')} format={man.get('format')} "
+              f"spans={len(spans)} dropped={man.get('dropped')} "
+              f"traces={man.get('traces')}")
+        by_name = {}
+        for sp in spans:
+            agg = by_name.setdefault(sp["name"], [0, 0.0])
+            agg[0] += 1
+            agg[1] += sp["t1"] - sp["t0"]
+        print(f"  {'span':<24} {'count':>6} {'total_ms':>10} {'avg_ms':>9}")
+        for name, (n, tot) in sorted(by_name.items(),
+                                     key=lambda kv: -kv[1][1]):
+            print(f"  {name:<24} {n:>6} {tot * 1e3:>10.2f} "
+                  f"{tot * 1e3 / n:>9.3f}")
+        slow = sorted(spans, key=lambda s: s["t0"] - s["t1"])[:5]
+        print("  slowest spans:")
+        for sp in slow:
+            print(f"    {(sp['t1'] - sp['t0']) * 1e3:>9.2f} ms  "
+                  f"{sp['name']}  trace={sp['trace'][:8]} "
+                  f"thread={sp.get('thread')}")
+        return 0
+
+    if args.trace_action == "dump":
+        from . import flags
+
+        if args.selftest:
+            import time
+
+            flags.set("trace", True)
+            with trace.span("selftest.root", kind="selftest"):
+                t0 = time.perf_counter()
+                with trace.span("selftest.child", n=1):
+                    pass
+                trace.record("selftest.retro", t0, time.perf_counter())
+        if not trace.enabled():
+            print("tracing is off (FLAGS_trace=0) — nothing recorded",
+                  file=sys.stderr)
+            return 1
+        path = trace.dump(reason="manual", out_dir=args.out)
+        spans, dropped = trace.snapshot()
+        print(f"dump written: {path} ({len(spans)} spans, "
+              f"{dropped} dropped)")
+        if args.selftest:
+            loaded = trace.load_dump(path)
+            names = {sp["name"] for sp in loaded["spans"]}
+            want = {"selftest.root", "selftest.child", "selftest.retro"}
+            if not want <= names:
+                print(f"selftest FAILED: missing {want - names}",
+                      file=sys.stderr)
+                return 1
+            print("selftest ok: record -> dump -> load round-trip")
+        return 0
+    return 1
+
+
 def _cmd_train(args):
     env = dict(os.environ)
     env["PADDLE_TRAINING_ROLE"] = args.role.upper()
@@ -220,6 +338,32 @@ def main(argv=None):
                    help="without --http: fire N synthetic requests from "
                         "concurrent clients and print stats JSON")
 
+    tr = sub.add_parser("trace", help="flight-recorder dumps and per-op "
+                                      "cost attribution")
+    trsub = tr.add_subparsers(dest="trace_action", required=True)
+    tro = trsub.add_parser("ops", help="compile a saved model once and "
+                                       "print the slowest-ops table")
+    tro.add_argument("--model-dir", required=True,
+                     help="save_inference_model directory")
+    tro.add_argument("--place", default="cpu", choices=["tpu", "cpu"])
+    tro.add_argument("--batch", type=int, default=1,
+                     help="batch size substituted for dynamic dims")
+    tro.add_argument("--top", type=int, default=10,
+                     help="rows in the table")
+    tro.add_argument("--json", action="store_true",
+                     help="emit the report as JSON")
+    trs = trsub.add_parser("summary", help="summarize a flight-recorder "
+                                           "dump directory")
+    trs.add_argument("dir", help="dump directory (holds manifest.json)")
+    trd = trsub.add_parser("dump", help="dump the in-process flight "
+                                        "recorder")
+    trd.add_argument("--out", default=None,
+                     help="output base dir (default FLAGS_trace_dump_dir "
+                          "or cwd)")
+    trd.add_argument("--selftest", action="store_true",
+                     help="record synthetic spans first and verify the "
+                          "dump loads back")
+
     t = sub.add_parser("train", help="launch a training script with "
                                      "cluster environment")
     t.add_argument("--role", default="trainer",
@@ -245,6 +389,8 @@ def main(argv=None):
             return _cmd_checkpoint(args)
         if args.command == "serve":
             return _cmd_serve(args)
+        if args.command == "trace":
+            return _cmd_trace(args)
         if args.command == "train":
             return _cmd_train(args)
     except BrokenPipeError:

@@ -36,6 +36,7 @@ import threading
 
 import numpy as np
 
+from .. import trace as _trace
 from ..flags import define, get as get_flag
 from .transfer import DONATE_KEY, WIRE_KEY
 
@@ -179,6 +180,11 @@ class AsyncDeviceFeeder:
         self._active = state
         sst, tst = self._stack_stats, self._transfer_stats
         wire = self._wire
+        # consumer-thread trace context, attached inside each transfer
+        # worker (explicit cross-thread propagation); snapshot of the
+        # flag so workers don't re-read it per chunk
+        tracing = _trace.enabled()
+        tctx = _trace.current() if tracing else None
         puts_copy = self._stage_fn is not None or _device_put_copies(dev)
         reuse_buffers = self._stage_fn is None and puts_copy
 
@@ -278,6 +284,13 @@ class AsyncDeviceFeeder:
                 return idx, stacked
 
         def work(lst):
+            if tracing:
+                with _trace.attach(tctx):
+                    work_loop(lst)
+            else:
+                work_loop(lst)
+
+        def work_loop(lst):
             # buf_holder: this worker's private staging buffers — safe to
             # refill once its previous transfer has completed (we block on
             # the transfer below before looping)
@@ -287,11 +300,16 @@ class AsyncDeviceFeeder:
                     while not tickets.acquire(timeout=0.2):
                         if state["stop"]:
                             return
+                    tp = time.perf_counter()
                     nxt = pull_chunk(buf_holder)
                     if nxt is None:
                         tickets.release()
                         return
                     idx, stacked = nxt
+                    if tracing:
+                        _trace.record("datapipe.stack", tp,
+                                      time.perf_counter(), kind="datapipe",
+                                      attrs={"chunk": idx})
                     try:
                         t0 = time.perf_counter()
 
@@ -312,6 +330,11 @@ class AsyncDeviceFeeder:
                             staged = stage()
                         dt = time.perf_counter() - t0
                         nb = sum(a.nbytes for a in stacked.values())
+                        if tracing:
+                            _trace.record(
+                                "datapipe.transfer", t0, t0 + dt,
+                                kind="datapipe",
+                                attrs={"chunk": idx, "bytes": nb})
                         if tst:
                             tst.add_item(busy_s=dt, nbytes=nb)
                         if lst is not None:

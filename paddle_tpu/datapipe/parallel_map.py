@@ -13,6 +13,8 @@ reasoning the reference's threaded double-buffer reader relies on.
 
 import threading
 
+from .. import trace as _trace
+
 __all__ = ["ParallelMap"]
 
 
@@ -85,6 +87,13 @@ class ParallelMap:
                  "threads": ()}
         self._active = state
         st = self._stats
+        # trace context is captured HERE, on the consumer thread that
+        # starts the iteration, and attached inside each worker — worker
+        # threads are fresh and carry no context of their own. `tracing`
+        # is a per-iteration snapshot so workers don't re-read the flag
+        # per item.
+        tracing = _trace.enabled()
+        tctx = _trace.current() if tracing else None
 
         def pull():
             """One (idx, item) under the source lock; None at EOF."""
@@ -111,6 +120,13 @@ class ParallelMap:
                 return idx, item
 
         def work():
+            if tracing:
+                with _trace.attach(tctx):
+                    work_loop()
+            else:
+                work_loop()
+
+        def work_loop():
             try:
                 while not state["stop"]:
                     # ticket BEFORE pulling: bounds in-flight including the
@@ -126,8 +142,13 @@ class ParallelMap:
                     try:
                         t0 = time.perf_counter()
                         res = self._fn(item)
+                        t1 = time.perf_counter()
                         if st:
-                            st.add_item(busy_s=time.perf_counter() - t0)
+                            st.add_item(busy_s=t1 - t0)
+                        if tracing:
+                            _trace.record("datapipe.map", t0, t1,
+                                          kind="datapipe",
+                                          attrs={"idx": idx})
                     except BaseException as e:
                         with cond:
                             if state["error"] is None:

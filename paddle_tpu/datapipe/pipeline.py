@@ -26,6 +26,7 @@ batch into its chunk buffer under the pull lock before the next batch is
 pulled, which is the ring-reuse boundary batcher.py documents.
 """
 
+from .. import trace as _trace
 from .batcher import Batcher
 from .parallel_map import ParallelMap
 from .source import GeneratorSource, RecordIOSource, SkipSource, Source
@@ -163,6 +164,11 @@ class DataPipe:
         return self._stage_memo[(i, name)]
 
     def _build(self):
+        with _trace.span("datapipe.build", kind="datapipe",
+                         stages=len(self._ops)):
+            return self._build_stages()
+
+    def _build_stages(self):
         from .feeder import AsyncDeviceFeeder
 
         src = self._source

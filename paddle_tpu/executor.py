@@ -24,6 +24,7 @@ from .core.scope import global_scope, Scope
 from .core.registry import SeqTensor
 from .resilience import chaos as _chaos
 from .resilience import watchdog as _watchdog
+from .trace import costs as _trace_costs
 
 __all__ = ["Executor", "FetchFuture", "global_scope", "scope_guard",
            "fetch_var"]
@@ -501,6 +502,7 @@ class Executor:
                 # attribute trace + compile to the "compile" phase
                 mon.phase("compile", build_s + call_s)
                 monitor.record_compile(fp, wall_s=build_s + call_s)
+                _trace_costs.register_program(fp, program)
             else:
                 mon.phase("dispatch", call_s)  # enqueue time (async)
         # write back BEFORE any nan check can raise: mut_state was donated,
@@ -681,6 +683,7 @@ class Executor:
             if was_miss:  # first call compiles under async dispatch
                 mon.phase("compile", build_s + call_s)
                 monitor.record_compile(fp, wall_s=build_s + call_s)
+                _trace_costs.register_program(fp, program)
             else:
                 mon.phase("dispatch", call_s)
         if plan is not None:

@@ -72,16 +72,24 @@ class JournalWriter:
 
 def read_journal(path):
     """Parse a JSONL journal -> list of step records (skips blank lines;
-    a torn final line — crash mid-write — is dropped, not fatal)."""
+    a torn line — crash mid-write — is dropped with a warning, not
+    fatal: the reader should know records went missing, silently eating
+    them hid real data loss)."""
+    import warnings
+
     records = []
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
             try:
                 records.append(json.loads(line))
-            except json.JSONDecodeError:
+            except json.JSONDecodeError as e:
+                warnings.warn(
+                    f"journal {path}: skipping unparseable line "
+                    f"{lineno} ({e}) — truncated write?",
+                    RuntimeWarning, stacklevel=2)
                 continue
     return records
 

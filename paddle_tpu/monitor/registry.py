@@ -28,6 +28,13 @@ DEFAULT_MS_BUCKETS = (0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
+def _escape_label_value(v):
+    """Prometheus text-format label-value escaping: backslash first, then
+    double-quote and newline (exposition format spec)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _series_name(name, labels):
     if not labels:
         return name
@@ -168,7 +175,8 @@ class Histogram(_Metric):
         first occupied bucket, the upper edge of the +Inf bucket) and
         clamping the estimate — so a one-value histogram reports that
         value exactly instead of a bucket boundary. Empty histogram ->
-        {p: None}."""
+        {p: NaN}: NaN propagates through arithmetic and formats as 'nan'
+        instead of blowing up the first comparison the way None does."""
         for p in ps:
             if not 0.0 <= float(p) <= 100.0:
                 raise ValueError(f"percentile {p} outside [0, 100]")
@@ -177,7 +185,7 @@ class Histogram(_Metric):
             counts = list(self._counts)
             mn, mx = self._min, self._max
         if count == 0:
-            return {p: None for p in ps}
+            return {p: float("nan") for p in ps}
         out = {}
         for p in ps:
             rank = float(p) / 100.0 * count
@@ -247,9 +255,12 @@ class MetricsRegistry:
     def exposition(self):
         """Prometheus text exposition (one scrape page).
 
-        Names are sanitized to the Prometheus charset; histograms emit
-        cumulative _bucket{le=...} series plus _sum/_count, counters get
-        the conventional _total suffix left to the caller's naming."""
+        Names are sanitized to the Prometheus charset; label VALUES are
+        escaped per the text-format spec (backslash, double-quote and
+        newline) — a fingerprint or path label containing any of those
+        must not corrupt the scrape page. Histograms emit cumulative
+        _bucket{le=...} series plus _sum/_count, counters get the
+        conventional _total suffix left to the caller's naming."""
         by_name = {}
         for m in self.metrics():
             by_name.setdefault(m.name, []).append(m)
@@ -263,8 +274,9 @@ class MetricsRegistry:
             lines.append(f"# TYPE {pname} {fam[0].kind}")
             for m in fam:
                 items = sorted(m.labels.items())
-                base = ",".join(f'{_NAME_RE.sub("_", k)}="{v}"'
-                                for k, v in items)
+                base = ",".join(
+                    f'{_NAME_RE.sub("_", k)}="{_escape_label_value(v)}"'
+                    for k, v in items)
                 if isinstance(m, Histogram):
                     snap = m.snapshot()
                     for le, n in snap["buckets"].items():

@@ -66,6 +66,19 @@ def enabled():
     return bool(flags.get("monitor"))
 
 
+_trace_mod = [None]
+
+
+def _trace():
+    """Lazy paddle_tpu.trace handle (trace imports monitor; importing it
+    at module top would be circular)."""
+    if _trace_mod[0] is None:
+        from .. import trace
+
+        _trace_mod[0] = trace
+    return _trace_mod[0]
+
+
 def registry():
     return _registry
 
@@ -92,7 +105,8 @@ def reset():
 class StepRecord:
     """Accumulates one step's phases; built only when monitoring is on."""
 
-    __slots__ = ("kind", "t0", "phases", "cache", "fingerprint", "extra")
+    __slots__ = ("kind", "t0", "phases", "cache", "fingerprint", "extra",
+                 "intervals")
 
     def __init__(self, kind):
         self.kind = kind
@@ -101,9 +115,18 @@ class StepRecord:
         self.cache = None    # "hit" | "miss"
         self.fingerprint = None
         self.extra = None    # journal-only extras
+        self.intervals = []  # (name, t0, t1) per occurrence — the phase
+        #                      boundaries step_end replays as trace spans
 
-    def phase(self, name, seconds):
+    def phase(self, name, seconds, interval=None):
         self.phases[name] = self.phases.get(name, 0.0) + float(seconds)
+        if interval is None:
+            # direct callers report a duration after the fact; anchor the
+            # interval so it ENDS now (executor calls phase() right after
+            # timing the block)
+            t1 = time.perf_counter()
+            interval = (t1 - float(seconds), t1)
+        self.intervals.append((name, interval[0], interval[1]))
 
     @contextlib.contextmanager
     def timed(self, name):
@@ -111,7 +134,8 @@ class StepRecord:
         try:
             yield
         finally:
-            self.phase(name, time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            self.phase(name, t1 - t0, interval=(t0, t1))
 
     def mark_cache(self, hit, fingerprint=None):
         self.cache = "hit" if hit else "miss"
@@ -287,6 +311,23 @@ def step_end(rec, iters=None, datapipe=None, replica_ms=None,
     writer = _journal_writer()
     if writer is not None:
         writer.write(record)
+
+    # retroactive trace emission: the step and its phase boundaries are
+    # already measured above, so the flight recorder gets them for free —
+    # one extra flag check per step when tracing is off
+    tr = _trace()
+    if tr.enabled():
+        attrs = {"step": step_idx}
+        if iters is not None:
+            attrs["iters"] = iters
+        if rec.cache is not None:
+            attrs["cache"] = rec.cache
+            attrs["fingerprint"] = rec.fingerprint
+        ctx = tr.record(f"{rec.kind}.step", rec.t0,
+                        rec.t0 + total_ms / 1000.0, kind="step",
+                        attrs=attrs)
+        for name, p0, p1 in rec.intervals:
+            tr.record(name, p0, p1, kind="phase", parent=ctx)
     return record
 
 

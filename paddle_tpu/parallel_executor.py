@@ -35,6 +35,7 @@ from .core.scope import global_scope
 from .executor import as_numpy, _apply_debug_nans
 from .resilience import chaos as _chaos
 from .resilience import watchdog as _watchdog
+from .trace import costs as _trace_costs
 
 __all__ = ["ParallelExecutor", "ExecutionStrategy", "BuildStrategy"]
 
@@ -370,6 +371,7 @@ class ParallelExecutor:
             if was_miss:  # first call compiles under async dispatch
                 mon.phase("compile", build_s + call_s)
                 monitor.record_compile(fp, wall_s=build_s + call_s)
+                _trace_costs.register_program(fp, self._program)
             else:
                 mon.phase("dispatch", call_s)
         for n, v in new_mut.items():

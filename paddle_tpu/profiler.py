@@ -207,6 +207,17 @@ def export_chrome_trace(path):
             "pid": 0,
             "args": {"value": value},
         })
+    # third lane: flight-recorder spans (pid 1) on the same time origin —
+    # serve/datapipe/step spans line up against host events and the
+    # device trace
+    try:
+        from . import trace as _trace_mod
+
+        spans, _dropped = _trace_mod.snapshot()
+        if spans:
+            events.extend(_trace_mod.chrome_events(spans, t0=t0))
+    except Exception:
+        pass
     if _last_trace_dir:
         events.extend(_load_device_trace(_last_trace_dir))
     with open(path, "w") as f:

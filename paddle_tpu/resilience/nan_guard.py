@@ -78,6 +78,12 @@ class NanGuard:
             "nan_steps_total",
             help="steps whose fetched metrics contained NaN/Inf",
             policy=policy).inc()
+        try:  # flight recorder: the steps leading up to the bad batch
+            from .. import trace as _trace_mod
+
+            _trace_mod.maybe_dump("nan_guard")
+        except Exception:
+            pass
         if policy == "raise":
             at = f" at step {step}" if step is not None else ""
             raise NanLossError(

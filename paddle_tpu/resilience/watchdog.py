@@ -70,6 +70,15 @@ def dump_stacks(label="manual", out=None):
             profiler.export_chrome_trace(trace_path)
     except Exception:
         trace_path = None
+    # flight recorder: a hang is exactly what the span ring buffer is for
+    # — what every thread was doing in the seconds before the deadline
+    try:
+        from .. import trace as _trace_mod
+
+        if _trace_mod.enabled():
+            _trace_mod.dump(reason=f"hang_{label}", out_dir=dump_dir)
+    except Exception:
+        pass
     _last_dump[0] = path
     monitor.registry().counter(
         "watchdog_dumps_total",

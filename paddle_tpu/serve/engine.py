@@ -29,6 +29,7 @@ from concurrent.futures import Future, InvalidStateError
 import numpy as np
 
 from .. import monitor
+from .. import trace as _trace
 from ..core.framework import Program, Variable
 from ..core.places import CPUPlace, TPUPlace
 from ..core.scope import Scope, scope_guard
@@ -125,7 +126,8 @@ class ServeConfig:
 
 
 class _Request:
-    __slots__ = ("feed", "rows", "future", "t_submit", "t_picked")
+    __slots__ = ("feed", "rows", "future", "t_submit", "t_picked",
+                 "tctx", "tparent")
 
     def __init__(self, feed, rows):
         self.feed = feed
@@ -133,6 +135,11 @@ class _Request:
         self.future = Future()
         self.t_submit = time.perf_counter()
         self.t_picked = None
+        # trace identity, pre-allocated at submit() when tracing is on:
+        # the batch span links to tctx long before the request span
+        # itself is recorded (fan-in attribution survives coalescing)
+        self.tctx = None
+        self.tparent = None
 
 
 class _RequestQueue:
@@ -505,6 +512,11 @@ class Server:
             raise ServeError("server not started (call start() first)")
         vals, rows = self._normalize(feed)
         req = _Request(vals, rows)
+        if _trace.enabled():
+            # inherit the submitter's context (the HTTP handler's
+            # serve.http span) so the whole lifecycle is ONE trace
+            req.tparent = _trace.current()
+            req.tctx = _trace.new_context(parent=req.tparent)
         reg = monitor.registry()
         try:
             self._queue.put(req)
@@ -512,6 +524,7 @@ class Server:
             self._own["rejected"].inc()
             reg.counter("serve_rejected_total",
                         help="requests rejected by admission control").inc()
+            _trace.maybe_dump("server_overloaded")
             raise
         self._own["requests"].inc()
         reg.counter("serve_requests_total",
@@ -583,7 +596,9 @@ class Server:
         q = self._dispatch_queues[self._rr]
         self._rr = (self._rr + 1) % len(self._dispatch_queues)
         try:
-            q.put((batch, feed, bucket, rows, pad_s))
+            # t0 anchors the serve.pad span; workers tolerate bare
+            # 5-tuples (tests construct them directly)
+            q.put((batch, feed, bucket, rows, pad_s, t0))
         except ServerClosed as e:
             self._fail_batch(batch, e)
 
@@ -598,16 +613,26 @@ class Server:
             item = q.get()
             if item is None:
                 return
-            batch, feed, bucket, rows, pad_s = item
+            batch, feed, bucket, rows, pad_s = item[:5]
+            t_pad = item[5] if len(item) > 5 else None
+            # fan-in span: ONE dispatch serves N coalesced requests, so
+            # the batch span LINKS to every request's context instead of
+            # parenting under any one of them; the executor's step span
+            # parents under it via the attached thread-local context
+            links = [r.tctx for r in batch if r.tctx is not None] \
+                if _trace.enabled() else None
+            bspan = _trace.span("serve.batch", kind="serve", links=links,
+                                bucket=bucket, rows=rows, replica=idx)
             try:
-                t0 = time.perf_counter()
-                outs = exe.run(self.program, feed=feed,
-                               fetch_list=self.fetch_list, scope=scope,
-                               return_numpy=False)
-                dispatch_s = time.perf_counter() - t0
-                t1 = time.perf_counter()
-                host = [np.asarray(as_numpy(o)) for o in outs]
-                readback_s = time.perf_counter() - t1
+                with bspan:
+                    t0 = time.perf_counter()
+                    outs = exe.run(self.program, feed=feed,
+                                   fetch_list=self.fetch_list, scope=scope,
+                                   return_numpy=False)
+                    dispatch_s = time.perf_counter() - t0
+                    t1 = time.perf_counter()
+                    host = [np.asarray(as_numpy(o)) for o in outs]
+                    readback_s = time.perf_counter() - t1
             except BaseException as e:  # noqa: BLE001 — fail the futures
                 self._fail_batch(batch, e)
                 continue
@@ -621,7 +646,10 @@ class Server:
                     # expired) must not kill this worker thread
                     if _resolve(r.future, result=res):
                         self._record_request(r, pad_s, dispatch_s,
-                                             readback_s, done, replica=idx)
+                                             readback_s, done, replica=idx,
+                                             batch_ctx=bspan.ctx,
+                                             t_pad=t_pad, t_dispatch=t0,
+                                             t_readback=t1)
             except BaseException as e:  # noqa: BLE001 — fail the futures
                 self._fail_batch(batch, e)
 
@@ -629,7 +657,8 @@ class Server:
         return monitor.registry().gauge(name, help=help)
 
     def _record_request(self, req, pad_s, dispatch_s, readback_s, done,
-                        replica):
+                        replica, batch_ctx=None, t_pad=None,
+                        t_dispatch=None, t_readback=None):
         reg = monitor.registry()
         total_ms = (done - req.t_submit) * 1000.0
         queue_ms = ((req.t_picked or req.t_submit) - req.t_submit) * 1000.0
@@ -648,10 +677,38 @@ class Server:
                     help="requests served per replica",
                     replica=str(replica)).inc()
         slo = self.config.slo_ms
-        if slo is not None and total_ms > slo:
+        violated = slo is not None and total_ms > slo
+        if violated:
             self._own["slo_violations"].inc()
             reg.counter("serve_slo_violations_total",
                         help="requests exceeding ServeConfig.slo_ms").inc()
+        if req.tctx is not None and _trace.enabled():
+            # retroactive lifecycle spans under the identity allocated at
+            # submit(): root request span (linked to the batch that
+            # carried it) + queue/pad/dispatch/readback children
+            picked = req.t_picked or req.t_submit
+            ctx = _trace.record(
+                "serve.request", req.t_submit, done, kind="serve",
+                ctx=req.tctx, parent=req.tparent,
+                links=[batch_ctx] if batch_ctx is not None else None,
+                attrs={"rows": req.rows, "replica": replica,
+                       "total_ms": round(total_ms, 3),
+                       "slo_violated": violated})
+            _trace.record("serve.queue", req.t_submit, picked,
+                          kind="serve", parent=ctx)
+            if t_pad is not None:
+                _trace.record("serve.pad", t_pad, t_pad + pad_s,
+                              kind="serve", parent=ctx)
+            if t_dispatch is not None:
+                _trace.record("serve.dispatch", t_dispatch,
+                              t_dispatch + dispatch_s, kind="serve",
+                              parent=ctx)
+            if t_readback is not None:
+                _trace.record("serve.readback", t_readback,
+                              t_readback + readback_s, kind="serve",
+                              parent=ctx)
+        if violated:
+            _trace.maybe_dump("serve_slo")
 
     # -- visibility -----------------------------------------------------
     def _cache_entries(self):

@@ -20,6 +20,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from .. import monitor
+from .. import trace as _trace
 from .engine import ServeError, ServerClosed, ServerOverloaded
 
 __all__ = ["serve_http", "make_http_server"]
@@ -74,30 +75,41 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/v1/infer":
             self._reply_json(404, {"error": f"no route {self.path}"})
             return
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(length) or b"{}")
-            feed = _json_feed(payload, engine)
-            fut = engine.submit(feed)
-        except ServerOverloaded as e:
-            self._reply_json(429, {"error": str(e)})
-            return
-        except ServerClosed as e:
-            self._reply_json(503, {"error": str(e)})
-            return
-        except (ValueError, ServeError) as e:
-            self._reply_json(400, {"error": str(e)})
-            return
-        try:
-            outs = fut.result()
-        except ServerClosed as e:
-            self._reply_json(503, {"error": str(e)})
-            return
-        except Exception as e:  # noqa: BLE001 — surface model errors
-            self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
-            return
-        self._reply_json(200, {
-            "outputs": [np.asarray(o).tolist() for o in outs]})
+        # root span of the request's trace: submit() runs inside it, so
+        # the engine's serve.request span (and everything under it)
+        # inherits this span's trace id — HTTP accept through readback
+        # reconstructs as one trace from a flight-recorder dump
+        with _trace.span("serve.http", kind="serve", path=self.path) as sp:
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                feed = _json_feed(payload, engine)
+                fut = engine.submit(feed)
+            except ServerOverloaded as e:
+                sp.set(status=429)
+                self._reply_json(429, {"error": str(e)})
+                return
+            except ServerClosed as e:
+                sp.set(status=503)
+                self._reply_json(503, {"error": str(e)})
+                return
+            except (ValueError, ServeError) as e:
+                sp.set(status=400)
+                self._reply_json(400, {"error": str(e)})
+                return
+            try:
+                outs = fut.result()
+            except ServerClosed as e:
+                sp.set(status=503)
+                self._reply_json(503, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001 — surface model errors
+                sp.set(status=500)
+                self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            sp.set(status=200)
+            self._reply_json(200, {
+                "outputs": [np.asarray(o).tolist() for o in outs]})
 
 
 def make_http_server(engine, host="127.0.0.1", port=8000):

@@ -1,0 +1,51 @@
+"""paddle_tpu.trace — end-to-end distributed tracing + flight recorder.
+
+The monitor (paddle_tpu.monitor) answers "what is the average"; trace
+answers "why was THIS one slow" and "what happened right before the
+hang". Three hot paths are instrumented end to end:
+
+  serve     serve.http -> serve.request -> queue/pad/dispatch/readback
+            child spans per request; the batcher's fan-in dispatch is a
+            serve.batch span LINKED to every coalesced request's context
+            (one slow request stays attributable after batching).
+  training  <kind>.step spans with feed_wait/feed_encode/compile/
+            dispatch/fetch_readback phase children, replayed from
+            monitor.StepRecord's existing phase boundaries at step_end;
+            datapipe.map / datapipe.stack / datapipe.transfer worker
+            spans with explicit context propagation into the pools.
+  compiles  compile phases carry the cache fingerprint; costs.py joins
+            the fingerprint's HLO cost totals back onto ProgramDesc ops
+            for the slowest-ops table (`paddle_tpu trace ops`).
+
+Spans land in an in-memory flight recorder (recorder.py): per-thread
+fixed-size rings, dumped (spans.jsonl + chrome trace.json +
+manifest.json) when the resilience watchdog fires, the NaN guard trips,
+a serve SLO violation / ServerOverloaded occurs, or on demand via
+`python -m paddle_tpu trace dump`.
+
+Off contract (FLAGS_trace=0, the default): one flag check per
+instrumentation site, no allocation — same deal as FLAGS_monitor.
+See docs/observability.md.
+"""
+
+from .costs import (attribute_costs, format_ops_table, op_costs,
+                    register_program, registered_fingerprints,
+                    slowest_ops)
+from .export import CHROME_PID, FORMAT, chrome_events, load_dump, write_dump
+from .recorder import (append, dump, last_dump, maybe_dump, reset,
+                       snapshot)
+from .span import (SpanContext, attach, current, enabled, new_context,
+                   record, span)
+
+__all__ = [
+    # span API
+    "SpanContext", "enabled", "current", "new_context", "attach", "span",
+    "record",
+    # flight recorder
+    "append", "snapshot", "reset", "dump", "maybe_dump", "last_dump",
+    # dump formats
+    "FORMAT", "CHROME_PID", "chrome_events", "write_dump", "load_dump",
+    # per-op cost attribution
+    "register_program", "registered_fingerprints", "op_costs",
+    "attribute_costs", "slowest_ops", "format_ops_table",
+]

@@ -3,6 +3,7 @@ visibility, replica skew, MFU accounting, and the disabled-mode
 zero-overhead contract (FLAGS_monitor=0 => ONE flag check per step)."""
 
 import json
+import math
 
 import numpy as np
 import pytest
@@ -83,8 +84,12 @@ def test_histogram_percentiles():
     reg = MetricsRegistry()
     h = reg.histogram("lat_ms", buckets=(1.0, 10.0, 100.0))
 
-    # empty histogram: None per requested percentile
-    assert h.percentiles(50, 99) == {50: None, 99: None}
+    # empty histogram: NaN per requested percentile (propagates through
+    # arithmetic instead of raising on the first comparison)
+    empty = h.percentiles(50, 99)
+    assert set(empty) == {50, 99}
+    assert all(isinstance(v, float) and math.isnan(v)
+               for v in empty.values())
 
     # one value: reported exactly (min/max clamp), not a bucket edge
     h.observe(7.0)
@@ -130,6 +135,18 @@ def test_registry_exposition_format():
     assert 'step_ms_bucket{le="+Inf"} 1' in text
     assert "step_ms_sum 3.0" in text
     assert "step_ms_count 1" in text
+
+
+def test_registry_exposition_escapes_label_values():
+    # text-format spec: backslash, double-quote and newline in label
+    # VALUES must be escaped or the scrape page is corrupt
+    reg = MetricsRegistry()
+    reg.counter("odd_total", path='C:\\tmp\\"x"\nend').inc()
+    text = reg.exposition()
+    assert 'path="C:\\\\tmp\\\\\\"x\\"\\nend"' in text
+    # the raw newline must not survive into the series line
+    series = [l for l in text.splitlines() if l.startswith("odd_total")]
+    assert len(series) == 1 and series[0].endswith(" 1.0")
 
 
 # ---------------------------------------------------------------------------
@@ -253,7 +270,8 @@ def test_journal_roundtrip_schema(tmp_path):
 def test_journal_skips_torn_final_line(tmp_path):
     p = tmp_path / "torn.jsonl"
     p.write_text('{"step": 1, "total_ms": 2.0}\n{"step": 2, "tot')
-    records = monitor.read_journal(str(p))
+    with pytest.warns(RuntimeWarning, match="line 2.*truncated"):
+        records = monitor.read_journal(str(p))
     assert [r["step"] for r in records] == [1]
 
 

@@ -162,6 +162,76 @@ if [ $? -ne 0 ]; then
     exit 1
 fi
 
+# trace smoke: with tracing on, serve a few requests (recording serve +
+# executor spans into the flight recorder), then synthesize a hang — arm
+# the watchdog with a tiny deadline and sleep past it — and assert the
+# watchdog's flight-recorder dump holds a LOADABLE chrome trace containing
+# both serve and executor spans. Also: an SLO violation and a NaN-guard
+# trip must each produce their own dump.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import glob, json, tempfile, time
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import flags, monitor, serve, trace
+from paddle_tpu.resilience import NanGuard, watchdog
+
+dump_dir = tempfile.mkdtemp(prefix="trace_gate_")
+flags.set("monitor", True)
+flags.set("trace", True)
+flags.set("trace_dump_dir", dump_dir)
+flags.set("trace_dump_cooldown_s", 0.0)
+flags.set("hang_dump_dir", dump_dir)
+monitor.reset()
+trace.reset()
+
+prog, startup = fluid.Program(), fluid.Program()
+with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    y = fluid.layers.fc(input=x, size=4)
+scope = fluid.Scope()
+exe = fluid.Executor(fluid.CPUPlace())
+with fluid.scope_guard(scope):
+    exe.run(startup)
+server = serve.Server(
+    prog, ["x"], [y], place=fluid.CPUPlace(), scope=scope,
+    config=serve.ServeConfig(max_batch=4, slo_ms=0.000001))
+server.start()
+for i in range(3):
+    out, = server.submit(
+        {"x": np.full(8, float(i), np.float32)}).result(timeout=60)
+    assert out.shape == (1, 4)
+time.sleep(0.2)  # SLO dump happens on the worker thread
+server.stop()
+
+# synthetic hang: chaos delay faults fire before the watchdog arms, so
+# arm manually around a sleep — deterministic and identical to a stuck
+# dispatch from the watchdog's point of view
+token = watchdog.arm("executor", deadline_ms=50)
+time.sleep(0.5)
+assert watchdog.disarm(token), "watchdog did not fire"
+
+hang_dumps = glob.glob(f"{dump_dir}/trace_hang_executor_*")
+assert hang_dumps, f"no flight-recorder hang dump in {dump_dir}"
+with open(f"{hang_dumps[0]}/trace.json") as f:
+    chrome = json.load(f)  # must be loadable chrome-trace JSON
+names = {e.get("name") for e in chrome["traceEvents"]
+         if e.get("ph") == "X"}
+assert "serve.request" in names and "serve.batch" in names, names
+assert "executor.step" in names, names
+assert glob.glob(f"{dump_dir}/trace_serve_slo_*"), "no SLO dump"
+
+assert NanGuard(policy="skip").check({"loss": float("nan")}) == "skip"
+assert glob.glob(f"{dump_dir}/trace_nan_guard_*"), "no NaN-guard dump"
+
+import shutil
+shutil.rmtree(dump_dir, ignore_errors=True)
+print("trace smoke: ok")
+EOF
+if [ $? -ne 0 ]; then
+    echo "GATE: TRACE SMOKE RED — do not commit" >&2
+    exit 1
+fi
+
 # bench --dry must emit the MFU-accounting keys the BENCH artifact carries,
 # plus the serving A/B block (batched vs unbatched QPS with percentiles)
 dry_out=$(JAX_PLATFORMS=cpu python bench.py --dry | tail -1)
@@ -176,6 +246,12 @@ for key in ("unbatched_qps", "batched_qps", "speedup",
             "p50_ms", "p95_ms", "p99_ms"):
     assert srv.get(key) is not None, (key, srv)
 assert srv["steady_state_compiles"] == 0, srv
+tr = result["trace"]
+for key in ("off_step_ms", "on_step_ms", "off_delta_frac"):
+    assert tr.get(key) is not None, (key, tr)
+# FLAGS_trace=0 overhead contract: step time must not move (<=1%, with
+# an absolute floor because sub-ms CPU steps make timer jitter dominate)
+assert tr["off_delta_ok"], tr
 print("bench --dry: ok")
 '
 if [ $? -ne 0 ]; then
