@@ -488,6 +488,161 @@ def measure_serve(fluid, place=None, requests=None, max_batch=None,
     }
 
 
+# fleet sizing (bench.py --fleet): N in-process replicas behind their
+# real HTTP frontends, one Router, mixed open-loop load.
+FLEET_REPLICAS = int(os.environ.get("BENCH_FLEET_REPLICAS", 3))
+FLEET_REQUESTS = int(os.environ.get("BENCH_FLEET_REQUESTS", 240))
+FLEET_CLIENTS = int(os.environ.get("BENCH_FLEET_CLIENTS", 12))
+FLEET_PACE_MS = float(os.environ.get("BENCH_FLEET_PACE_MS", 2.0))
+
+
+def measure_fleet(fluid, place=None):
+    """Fleet serving benchmark: FLEET_REPLICAS replica engines, EACH
+    behind its own real HTTP frontend, load-balanced by a fleet Router.
+    Mixed open-loop load (varying row counts, paced submissions — the
+    clients don't wait for capacity, so queueing is real); reports
+    sustained QPS, router-side p50/p95/p99 and the per-replica request
+    split. Then one traced request goes through the REAL router->HTTP->
+    engine path and the flight recorder must reconstruct it end to end:
+    fleet.request -> fleet.attempt -> serve.http -> serve.request in ONE
+    trace id (plus the serve.batch span the request's rows rode in,
+    found via the batch's links)."""
+    import threading
+
+    from paddle_tpu import flags, monitor, serve, trace
+    from paddle_tpu.serve.fleet import FleetConfig, Router
+    from paddle_tpu.serve.http import make_http_server
+
+    place = fluid.CPUPlace() if place is None else place
+    monitor.reset()
+    prog, startup, predict = _build_serve_program(fluid)
+    servers, httpds, endpoints = [], [], {}
+    for i in range(FLEET_REPLICAS):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(place)
+            exe.run(startup)
+        server = serve.Server(
+            prog, ["x"], [predict], place=place, scope=scope,
+            config=serve.ServeConfig(max_batch=8, max_wait_ms=2.0,
+                                     max_queue_rows=512))
+        server.start()
+        httpd = make_http_server(server, port=0)
+        threading.Thread(target=httpd.serve_forever,
+                         name=f"fleet-bench-http-{i}", daemon=True).start()
+        servers.append(server)
+        httpds.append(httpd)
+        endpoints[f"r{i}"] = f"127.0.0.1:{httpd.server_address[1]}"
+    router = Router(endpoints,
+                    config=FleetConfig(probe_interval_s=0.2,
+                                       request_deadline_ms=30000.0))
+    router.start()
+    assert router.membership.healthy_count() == FLEET_REPLICAS, \
+        router.membership.describe()
+
+    per = FLEET_REQUESTS // FLEET_CLIENTS
+    codes, split = {}, {}
+    lock = threading.Lock()
+
+    def client(cid):
+        rng = np.random.RandomState(cid)
+        for _ in range(per):
+            rows = int(rng.choice([1, 1, 1, 2, 4]))
+            body = json.dumps({"inputs": {"x": rng.rand(
+                rows, SERVE_FEAT).round(4).tolist()}}).encode("utf-8")
+            status, hdrs, _out = router.route(body)
+            with lock:
+                codes[status] = codes.get(status, 0) + 1
+                rep = hdrs.get("X-Fleet-Replica")
+                if rep:
+                    split[rep] = split.get(rep, 0) + 1
+            # open-loop-ish pacing: submit on a clock, not on completion
+            time.sleep(FLEET_PACE_MS / 1000.0 * rng.rand() * 2)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(FLEET_CLIENTS)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.time() - t0
+    pct = router.latency_percentiles(50, 95, 99)
+
+    # -- end-to-end trace reconstruction through the real HTTP path --
+    flags.set("trace", True)
+    trace.reset()
+    body = json.dumps({"inputs": {"x": [[0.5] * SERVE_FEAT]}}).encode()
+    status, _h, _b = router.route(body)
+    assert status == 200, status
+
+    def reconstruct():
+        spans, _dropped = trace.snapshot()
+        by_id = {sp["span"]: sp for sp in spans}
+
+        def parent_name(sp):
+            p = by_id.get(sp.get("parent"))
+            return p["name"] if p else None
+
+        roots = [sp for sp in spans if sp["name"] == "fleet.request"]
+        if not roots:
+            return [], False
+        tid = roots[0]["trace"]
+        in_trace = [sp for sp in spans if sp["trace"] == tid]
+        names = {sp["name"] for sp in in_trace}
+        ok = (
+            {"fleet.request", "fleet.attempt", "serve.http",
+             "serve.request"} <= names
+            and any(parent_name(sp) == "fleet.request"
+                    for sp in in_trace if sp["name"] == "fleet.attempt")
+            and any(parent_name(sp) == "fleet.attempt"
+                    for sp in in_trace if sp["name"] == "serve.http")
+            # the batch the rows rode in links back to this trace's
+            # serve.request (the batch span itself lives on the batcher
+            # thread's own trace)
+            and any(l["trace"] == tid
+                    for sp in spans if sp["name"] == "serve.batch"
+                    for l in sp.get("links", ())))
+        return sorted(names), ok
+
+    # route() returns when the response body lands; the handler thread
+    # closes its serve.http span a hair later — poll briefly
+    chain, chain_ok = reconstruct()
+    deadline = time.time() + 5.0
+    while not chain_ok and time.time() < deadline:
+        time.sleep(0.05)
+        chain, chain_ok = reconstruct()
+    flags.set("trace", False)
+    trace.reset()
+
+    report = {
+        "replicas": FLEET_REPLICAS,
+        "clients": FLEET_CLIENTS,
+        "requests": per * FLEET_CLIENTS + 1,
+        "status_codes": {str(k): v for k, v in sorted(codes.items())},
+        "qps": round(per * FLEET_CLIENTS / dt, 1),
+        "p50_ms": pct[50], "p95_ms": pct[95], "p99_ms": pct[99],
+        "replica_split": dict(sorted(split.items())),
+        "retries": router.stats()["retries"],
+        "trace_chain": chain,
+        "trace_chain_ok": chain_ok,
+    }
+
+    # teardown: drain one replica THROUGH the router (the rolling-restart
+    # path), stop the rest directly
+    drain_report = router.drain("r0", timeout_s=15.0)
+    report["drain_ok"] = bool(drain_report["drained"])
+    report["drain_ms"] = round(drain_report["duration_ms"], 1)
+    router.stop()
+    for httpd in httpds:
+        httpd.shutdown()
+        httpd.server_close()
+    for server in servers:
+        if not server.stats()["draining"]:
+            server.stop()
+    return report
+
+
 # ResNet-50 at 224x224 is ~4.1 GFLOPs/image forward; training (fwd + bwd)
 # is conventionally ~3x forward. Used only when no HLO cost was captured.
 ANALYTIC_RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.1e9
@@ -632,6 +787,14 @@ def main():
         report = measure_serve(fluid)
         report["metric"] = "serve_batched_qps"
         report["value"] = report["batched_qps"]
+        print(json.dumps(report))
+        return
+
+    if "--fleet" in sys.argv:
+        # fleet routing is backend-independent; CPU keeps it CI-runnable
+        report = measure_fleet(fluid, place=fluid.CPUPlace())
+        report["metric"] = "fleet_qps"
+        report["value"] = report["qps"]
         print(json.dumps(report))
         return
 

@@ -36,6 +36,22 @@ Commands:
                      dump the in-process flight recorder (--selftest
                      records synthetic spans first, proving the
                      record->dump->load path end to end).
+  fleet replica --model-dir DIR [--port 0 --port-file F]
+                     run one serving replica process for a fleet: the
+                     serve engine behind its HTTP frontend, exiting 0
+                     after a graceful drain (POST /admin/drain or
+                     SIGTERM) with empty queues. --master registers a
+                     TTL heartbeat with a parallel.master service;
+                     --router registers with a fleet router over HTTP.
+                     --chaos-kill-at/--chaos-hang-at N arm a
+                     replica_kill/replica_hang fault on the Nth
+                     executor dispatch (failover drills).
+  fleet router [--replicas ep1,ep2,...] [--master HOST:PORT]
+                     run the fleet router: health-checked least-queue
+                     routing over the replica set with retry-on-other-
+                     replica, deadlines, a fleet-wide retry budget and
+                     graceful drain orchestration (POST /admin/drain
+                     {"replica": name}).
 """
 
 import argparse
@@ -174,6 +190,139 @@ def _cmd_serve(args):
     server.stop()
     print(json.dumps(stats, indent=2))
     return 0 if stats["steady_state_compiles"] == 0 else 1
+
+
+def _cmd_fleet_replica(args):
+    import json
+    import signal
+    import threading
+
+    from .core.places import CPUPlace, TPUPlace
+    from .serve import ServeConfig, Server
+    from .serve.http import make_http_server
+
+    if args.chaos_kill_at is not None or args.chaos_hang_at is not None:
+        from .resilience import chaos
+
+        monkey = chaos.ChaosMonkey()
+        if args.chaos_kill_at is not None:
+            monkey.add(chaos.Fault("replica_kill", at=args.chaos_kill_at))
+        if args.chaos_hang_at is not None:
+            monkey.add(chaos.Fault("replica_hang", at=args.chaos_hang_at,
+                                   delay_ms=args.chaos_hang_ms))
+        chaos.install(monkey)
+    place = CPUPlace() if args.place == "cpu" else TPUPlace(0)
+    config = ServeConfig(
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        replicas=args.replicas, max_queue_rows=args.max_queue_rows)
+    try:
+        server = Server.from_inference_model(
+            args.model_dir, place=place, config=config)
+    except (OSError, ValueError) as e:
+        print(f"cannot load inference model: {e}", file=sys.stderr)
+        return 1
+    server.start()
+    # a drained replica's frontend shuts itself down -> serve_forever
+    # returns -> this process exits 0: the rolling-restart contract
+    httpd = make_http_server(server, host=args.host, port=args.port,
+                             shutdown_on_drain=True)
+    port = httpd.server_address[1]
+    endpoint = f"{args.host}:{port}"
+    name = args.name or f"replica-{port}"
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(f"{port}\n")
+    print(f"replica {name} serving on {endpoint}", file=sys.stderr)
+
+    heartbeater = None
+    if args.master:
+        from .parallel.master import Heartbeater, MasterClient
+
+        heartbeater = Heartbeater(MasterClient(args.master), "serve",
+                                  name, endpoint, ttl=args.ttl)
+        heartbeater.start()
+    elif args.router:
+        import http.client
+
+        def _register_loop():
+            body = json.dumps({"name": name, "endpoint": endpoint})
+            while not server._stop:
+                try:
+                    host, rport = args.router.rsplit(":", 1)
+                    conn = http.client.HTTPConnection(host, int(rport),
+                                                      timeout=2.0)
+                    try:
+                        conn.request("POST", "/admin/register", body=body)
+                        conn.getresponse().read()
+                    finally:
+                        conn.close()
+                except OSError:
+                    pass  # router restart: next beat re-registers
+                stop_beats.wait(max(0.5, args.ttl / 3.0))
+
+        stop_beats = threading.Event()
+        threading.Thread(target=_register_loop, name="fleet-register",
+                         daemon=True).start()
+
+    def _sigterm(signum, frame):
+        # SIGTERM = drain, not die: finish the backlog, then exit clean
+        threading.Thread(target=server.drain, name="serve-drain-sig",
+                         daemon=True).start()
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        server.drain()
+    finally:
+        httpd.server_close()
+        if heartbeater is not None:
+            heartbeater.stop()
+            heartbeater.client.close()
+    stats = server.stats()
+    server.stop()
+    leftover = stats["queue_rows"]
+    print(f"replica {name} exiting: drained queue_rows={leftover}",
+          file=sys.stderr)
+    return 0 if leftover == 0 else 1
+
+
+def _cmd_fleet_router(args):
+    from .serve.fleet import FleetConfig, Router, serve_fleet
+
+    replicas = {}
+    if args.replicas:
+        for i, ep in enumerate(e for e in args.replicas.split(",") if e):
+            replicas[f"r{i}"] = ep
+    discover = None
+    if args.master:
+        from .parallel.master import MasterClient
+
+        client = MasterClient(args.master)
+        discover = lambda: client.lookup("serve")  # noqa: E731
+    if not replicas and discover is None:
+        print("router needs --replicas and/or --master", file=sys.stderr)
+        return 1
+    config = FleetConfig(
+        probe_interval_s=args.probe_interval,
+        request_deadline_ms=args.deadline_ms,
+        attempt_timeout_ms=args.attempt_timeout_ms,
+        max_attempts=args.max_attempts, hedge_ms=args.hedge_ms)
+    router = Router(replicas, config=config, discover=discover)
+    print(f"fleet router on {args.host}:{args.port} over "
+          f"{sorted(replicas.values()) or 'master-discovered replicas'}",
+          file=sys.stderr)
+    serve_fleet(router, host=args.host, port=args.port)
+    return 0
+
+
+def _cmd_fleet(args):
+    if args.fleet_action == "replica":
+        return _cmd_fleet_replica(args)
+    if args.fleet_action == "router":
+        return _cmd_fleet_router(args)
+    return 1
 
 
 def _cmd_trace(args):
@@ -364,6 +513,58 @@ def main(argv=None):
                      help="record synthetic spans first and verify the "
                           "dump loads back")
 
+    f = sub.add_parser("fleet", help="multi-replica serving: replica and "
+                                     "router processes")
+    fsub = f.add_subparsers(dest="fleet_action", required=True)
+    fr = fsub.add_parser("replica", help="run one serving replica process "
+                                         "(drains clean on /admin/drain "
+                                         "or SIGTERM)")
+    fr.add_argument("--model-dir", required=True,
+                    help="save_inference_model directory")
+    fr.add_argument("--place", default="cpu", choices=["tpu", "cpu"])
+    fr.add_argument("--host", default="127.0.0.1")
+    fr.add_argument("--port", type=int, default=0,
+                    help="HTTP port (0 = ephemeral; see --port-file)")
+    fr.add_argument("--port-file", default=None,
+                    help="write the bound port here once listening")
+    fr.add_argument("--name", default=None,
+                    help="replica name (default replica-<port>)")
+    fr.add_argument("--max-batch", type=int, default=8)
+    fr.add_argument("--max-wait-ms", type=float, default=2.0)
+    fr.add_argument("--replicas", type=int, default=1,
+                    help="engine executor replicas inside this process")
+    fr.add_argument("--max-queue-rows", type=int, default=None)
+    fr.add_argument("--router", default=None, metavar="HOST:PORT",
+                    help="register with this fleet router over HTTP")
+    fr.add_argument("--master", default=None, metavar="HOST:PORT",
+                    help="heartbeat a parallel.master TTL registration")
+    fr.add_argument("--ttl", type=float, default=10.0,
+                    help="registration TTL seconds")
+    fr.add_argument("--chaos-kill-at", type=int, default=None, metavar="N",
+                    help="SIGKILL this replica on its Nth executor "
+                         "dispatch (failover drill)")
+    fr.add_argument("--chaos-hang-at", type=int, default=None, metavar="N",
+                    help="hang this replica on its Nth executor dispatch")
+    fr.add_argument("--chaos-hang-ms", type=float, default=None,
+                    help="hang duration (default: effectively forever)")
+    fo = fsub.add_parser("router", help="run the fleet router over a "
+                                        "replica set")
+    fo.add_argument("--replicas", default="",
+                    help="comma-separated replica host:port list")
+    fo.add_argument("--master", default=None, metavar="HOST:PORT",
+                    help="discover replicas from a parallel.master "
+                         "registry (kind=serve)")
+    fo.add_argument("--host", default="127.0.0.1")
+    fo.add_argument("--port", type=int, default=8100)
+    fo.add_argument("--probe-interval", type=float, default=0.5)
+    fo.add_argument("--deadline-ms", type=float, default=30000.0,
+                    help="per-request routing deadline")
+    fo.add_argument("--attempt-timeout-ms", type=float, default=None,
+                    help="per-attempt transport timeout")
+    fo.add_argument("--max-attempts", type=int, default=3)
+    fo.add_argument("--hedge-ms", type=float, default=None,
+                    help="hedge a silent first attempt after this long")
+
     t = sub.add_parser("train", help="launch a training script with "
                                      "cluster environment")
     t.add_argument("--role", default="trainer",
@@ -391,6 +592,8 @@ def main(argv=None):
             return _cmd_serve(args)
         if args.command == "trace":
             return _cmd_trace(args)
+        if args.command == "fleet":
+            return _cmd_fleet(args)
         if args.command == "train":
             return _cmd_train(args)
     except BrokenPipeError:

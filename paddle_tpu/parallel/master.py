@@ -26,8 +26,9 @@ import time
 
 from . import rpc as _rpc
 
-__all__ = ["Task", "MasterService", "MasterClient", "task_iterator",
-           "PassAfter", "PassBefore", "NoMoreAvailable", "AllTasksFailed"]
+__all__ = ["Task", "MasterService", "MasterClient", "Heartbeater",
+           "task_iterator", "PassAfter", "PassBefore", "NoMoreAvailable",
+           "AllTasksFailed"]
 
 
 class PassBefore(RuntimeError):
@@ -362,6 +363,7 @@ class MasterClient:
         self._connect_timeout = float(connect_timeout)
         self._lock = threading.Lock()
         self._sock = None
+        self._closed = False
         if retry is None:
             from ..resilience.retry import RetryPolicy
 
@@ -371,10 +373,7 @@ class MasterClient:
             self._connect_locked()  # fail fast when the master is absent
 
     def _connect_locked(self):
-        host, port = self._endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection(
-            (host, int(port)), timeout=self._connect_timeout)
-        self._sock.settimeout(None)
+        self._sock = _rpc.dial(self._endpoint, self._connect_timeout)
 
     def _drop_locked(self):
         if self._sock is not None:
@@ -389,6 +388,14 @@ class MasterClient:
 
         def attempt():
             with self._lock:
+                # checked under the lock EVERY attempt: close() may land
+                # while the retry policy sleeps between attempts (outside
+                # the lock), and a post-close attempt must not re-dial —
+                # that socket would leak with nobody left to close it.
+                # RpcError is not transient, so the retry loop stops here.
+                if self._closed:
+                    raise _rpc.RpcError(
+                        f"master client for {self._endpoint} is closed")
                 try:
                     if self._sock is None:
                         self._connect_locked()
@@ -435,13 +442,17 @@ class MasterClient:
     def close(self):
         """Disconnect THIS client; the master keeps serving other trainers
         (a departing trainer must never take the coordination service — and
-        every live lease reaper — down with it)."""
+        every live lease reaper — down with it). Terminal: a concurrent
+        _call riding a reconnect-retry loop stops at its next attempt
+        instead of re-dialing a socket nobody would ever close."""
         with self._lock:
+            self._closed = True
             self._drop_locked()
 
     def shutdown_service(self):
         """Stop the master service itself (job teardown)."""
         with self._lock:
+            self._closed = True
             try:
                 if self._sock is None:
                     self._connect_locked()
@@ -449,6 +460,50 @@ class MasterClient:
             except OSError:
                 pass
             self._drop_locked()
+
+
+class Heartbeater:
+    """Background TTL re-registration against the master's discovery
+    registry (reference etcd_client.go keepalive lease): a serving-fleet
+    replica registers (kind, name, addr) and re-registers every ttl/3 so
+    the entry outlives hiccups but expires ~one ttl after the process
+    dies — which is exactly how the fleet router's discovery loop learns
+    about replica death without the replica saying goodbye. Registration
+    faults are swallowed (the retry policy inside MasterClient already
+    rode out what it could; a missed beat just shortens the lease)."""
+
+    def __init__(self, client, kind, name, addr, ttl=10.0, interval=None):
+        self._client = client
+        self._kind = kind
+        self._name = name
+        self._addr = addr
+        self._ttl = float(ttl)
+        self._interval = (self._ttl / 3.0 if interval is None
+                          else float(interval))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name=f"heartbeat-{name}",
+                                        daemon=True)
+        self.beats = 0
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self._client.register(self._kind, self._name, self._addr,
+                                      ttl=self._ttl)
+                self.beats += 1
+            except Exception:  # noqa: BLE001 — a missed beat is not fatal
+                pass
+            self._stop.wait(self._interval)
+
+    def stop(self, join=True):
+        self._stop.set()
+        if join and self._thread.is_alive():
+            self._thread.join(timeout=10.0)
 
 
 def task_iterator(client, pass_id, poll_interval=0.1, max_wait=60.0):

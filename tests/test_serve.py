@@ -430,6 +430,154 @@ def test_http_non_object_body_is_400():
 
 
 # ---------------------------------------------------------------------------
+# graceful drain (lame-duck) + the load-balancer-shaped failure mapping
+# ---------------------------------------------------------------------------
+
+def test_drain_serves_backlog_then_refuses_new_work():
+    # a long max_wait + underfull batch = requests still queued/held when
+    # drain hits; sealing must SERVE them (stop() would fail them)
+    server, exe, scope, prog, y = _fc_server(max_batch=8,
+                                             max_wait_ms=2000.0)
+    server.start()
+    futs = [server.submit({"x": np.full(4, float(i), np.float32)})
+            for i in range(3)]
+    t0 = time.perf_counter()
+    assert server.drain(timeout=30.0)
+    # the seal also short-circuits the batching wait: no 2 s linger
+    assert time.perf_counter() - t0 < 10.0
+    # the backlog was SERVED, not failed — that's drain vs stop
+    for i, fut in enumerate(futs):
+        out, = fut.result(timeout=0)
+        np.testing.assert_allclose(
+            out, _ref(exe, scope, prog, y,
+                      np.full((1, 4), float(i), np.float32)), rtol=1e-5)
+    assert server.state() == "stopped"
+    with pytest.raises(serve.ServerClosed):
+        server.submit({"x": np.zeros(4, np.float32)})
+
+
+def test_draining_server_rejects_submit_with_server_draining():
+    server, *_ = _fc_server()
+    with server:
+        server._draining = True  # lame-duck flag alone gates admission
+        with pytest.raises(serve.ServerDraining):
+            server.submit({"x": np.zeros(4, np.float32)})
+        server._draining = False
+    # ServerDraining IS a ServerClosed: existing handlers keep working
+    assert issubclass(serve.ServerDraining, serve.ServerClosed)
+
+
+def test_drain_is_idempotent_and_updates_state_telemetry():
+    server, *_ = _fc_server()
+    server.start()
+    server.submit({"x": np.zeros(4, np.float32)}).result(timeout=30)
+    assert server.state() == "serving" and not server.draining()
+    assert server.drain(timeout=30.0)
+    assert server.drain(timeout=30.0)  # second drain: already stopped
+    snap = monitor.registry().snapshot()
+    assert snap["serve_drains_total"] == 1
+    assert snap["serve_draining"] == 0
+    assert snap["serve_drain_duration_ms"] >= 0.0
+    assert server.stats()["state"] == "stopped"
+
+
+def _http_fixture(server):
+    httpd = make_http_server(server, port=0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, port
+
+
+def _post_infer(port, body=None):
+    body = body if body is not None else json.dumps(
+        {"inputs": {"x": [[1.0, 2.0, 3.0, 4.0]]}}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/infer", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers)
+
+
+def test_http_overloaded_is_503_with_retry_after():
+    # a full queue is "healthy but busy": the 503 + Retry-After contract
+    # is what lets a fleet router retry elsewhere instead of giving up.
+    # No batcher running (the queue stays full), same idiom as
+    # test_backpressure_rejects_beyond_max_queue_rows.
+    server, *_ = _fc_server(max_batch=4, max_queue_rows=4)
+    server._ready = True
+    server.submit({"x": np.zeros((4, 4), np.float32)})  # queue now full
+    httpd, port = _http_fixture(server)
+    try:
+        code, headers = _post_infer(port)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        server.stop()  # fails the parked request, resolving its future
+    assert code == 503
+    assert int(headers["Retry-After"]) >= 1
+
+
+def test_http_draining_is_503_with_connection_close():
+    server, *_ = _fc_server()
+    with server:
+        httpd, port = _http_fixture(server)
+        try:
+            server._draining = True
+            code, headers = _post_infer(port)
+            assert code == 503
+            assert headers["Connection"].lower() == "close"
+            # healthz mirrors the state for the prober
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz")
+                assert False, "healthz must 503 while draining"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert e.read().strip() == b"draining"
+        finally:
+            server._draining = False
+            httpd.shutdown()
+            httpd.server_close()
+
+
+def test_http_stopped_is_503_with_connection_close():
+    server, *_ = _fc_server()
+    server.start()
+    httpd, port = _http_fixture(server)
+    try:
+        server.stop()
+        code, headers = _post_infer(port)
+        assert code == 503
+        assert headers["Connection"].lower() == "close"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_http_admin_drain_endpoint_drains_and_shuts_down():
+    server, exe, scope, prog, y = _fc_server()
+    server.start()
+    httpd, port = _http_fixture(server)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/admin/drain", data=b"{}")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 202
+            assert json.loads(r.read())["state"] == "draining"
+        deadline = time.time() + 30
+        while server.state() != "stopped" and time.time() < deadline:
+            time.sleep(0.05)
+        assert server.state() == "stopped"
+        assert server.stats()["queue_rows"] == 0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
 # satellite: conv+bn folding (InferenceTranspiler) numeric equivalence
 # ---------------------------------------------------------------------------
 

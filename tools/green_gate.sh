@@ -259,4 +259,110 @@ if [ $? -ne 0 ]; then
     exit 1
 fi
 
+# fleet chaos smoke: 3 real replica PROCESSES behind the router, concurrent
+# clients, SIGKILL one replica mid-load — zero accepted requests lost, the
+# healthy-replica gauge drops 3->2 within a probe round — then drain a
+# second replica: it serves its backlog, exits 0, queues empty.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, signal, subprocess, sys, tempfile, threading, time
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.serve.fleet import FleetConfig, Router
+
+tmp = tempfile.mkdtemp(prefix="fleet_gate_")
+prog, startup = fluid.Program(), fluid.Program()
+with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=3)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(startup)
+model_dir = os.path.join(tmp, "model")
+with fluid.program_guard(prog, startup):
+    fluid.io.save_inference_model(model_dir, ["x"], [y], exe)
+
+procs, endpoints = [], {}
+env = dict(os.environ, JAX_PLATFORMS="cpu")
+try:
+    for i in range(3):
+        pf = os.path.join(tmp, f"port{i}")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu", "fleet", "replica",
+             "--model-dir", model_dir, "--place", "cpu",
+             "--port", "0", "--port-file", pf, "--name", f"r{i}"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+        deadline = time.time() + 120
+        while not os.path.exists(pf) and time.time() < deadline:
+            time.sleep(0.1)
+        with open(pf) as f:
+            endpoints[f"r{i}"] = f"127.0.0.1:{f.read().strip()}"
+
+    router = Router(endpoints, config=FleetConfig(probe_interval_s=0.2))
+    deadline = time.time() + 120
+    while router.membership.healthy_count() < 3 and time.time() < deadline:
+        router.prober.tick()
+        time.sleep(0.2)
+    assert router.membership.healthy_count() == 3, \
+        router.membership.describe()
+    router.prober.start()
+
+    body = json.dumps({"inputs": {"x": [[1.0, 2.0, 3.0, 4.0]]}}).encode()
+    codes, lock = {}, threading.Lock()
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            status, _h, _b = router.route(body)
+            with lock:
+                codes[status] = codes.get(status, 0) + 1
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.6)                      # load flowing through all three
+    os.kill(procs[1].pid, signal.SIGKILL)  # chaos: replica r1 dies NOW
+    t_kill = time.time()
+    while router.membership.healthy_count() > 2 \
+            and time.time() - t_kill < 10:
+        time.sleep(0.05)
+    t_detect = time.time() - t_kill
+    time.sleep(0.6)                      # keep the load on past the death
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+
+    # THE contract: every accepted request answered 200 (the router
+    # retried the dead replica's failures onto the survivors)
+    assert set(codes) == {200}, f"lost requests: {codes}"
+    assert sum(codes.values()) > 50, codes
+    assert router.membership.healthy_count() == 2
+    assert t_detect < 5.0, f"death detected only after {t_detect:.1f}s"
+    assert monitor.registry().snapshot()["fleet_healthy_replicas"] == 2
+
+    # rolling restart, second half: drain r0 through the router — it must
+    # finish its backlog, report stopped (or exit), and the process must
+    # exit 0 with empty queues
+    report = router.drain("r0", timeout_s=30.0)
+    assert report["drained"], report
+    rc = procs[0].wait(timeout=30)
+    assert rc == 0, f"drained replica exited {rc}"
+    retries = int(router.stats()["retries"])
+    router.stop()
+    print(f"fleet chaos smoke: ok ({sum(codes.values())} requests, "
+          f"0 lost, {retries} retried, death detected in "
+          f"{t_detect * 1000:.0f} ms, drain {report['duration_ms']:.0f} ms)")
+finally:
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=10)
+    import shutil
+    shutil.rmtree(tmp, ignore_errors=True)
+EOF
+if [ $? -ne 0 ]; then
+    echo "GATE: FLEET CHAOS SMOKE RED — do not commit" >&2
+    exit 1
+fi
+
 echo "GATE: green"
