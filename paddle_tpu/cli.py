@@ -265,10 +265,17 @@ def _cmd_fleet_replica(args):
                          daemon=True).start()
 
     def _sigterm(signum, frame):
-        # SIGTERM = drain, not die: finish the backlog, then exit clean
-        threading.Thread(target=server.drain, name="serve-drain-sig",
+        # SIGTERM = drain, not die: finish the backlog, THEN stop the
+        # HTTP loop — same ordering as /admin/drain's shutdown_on_drain
+        # path, so serve_forever() only returns once the queue is empty
+        # (shutting down concurrently would snapshot stats mid-drain and
+        # fail still-queued requests in the server.stop() below)
+        def _drain_then_exit():
+            server.drain()
+            httpd.shutdown()
+
+        threading.Thread(target=_drain_then_exit, name="serve-drain-sig",
                          daemon=True).start()
-        threading.Thread(target=httpd.shutdown, daemon=True).start()
 
     signal.signal(signal.SIGTERM, _sigterm)
     try:
@@ -278,8 +285,7 @@ def _cmd_fleet_replica(args):
     finally:
         httpd.server_close()
         if heartbeater is not None:
-            heartbeater.stop()
-            heartbeater.client.close()
+            heartbeater.close()
     stats = server.stats()
     server.stop()
     leftover = stats["queue_rows"]

@@ -473,7 +473,7 @@ class Heartbeater:
     rode out what it could; a missed beat just shortens the lease)."""
 
     def __init__(self, client, kind, name, addr, ttl=10.0, interval=None):
-        self._client = client
+        self.client = client
         self._kind = kind
         self._name = name
         self._addr = addr
@@ -493,8 +493,8 @@ class Heartbeater:
     def _loop(self):
         while not self._stop.is_set():
             try:
-                self._client.register(self._kind, self._name, self._addr,
-                                      ttl=self._ttl)
+                self.client.register(self._kind, self._name, self._addr,
+                                     ttl=self._ttl)
                 self.beats += 1
             except Exception:  # noqa: BLE001 — a missed beat is not fatal
                 pass
@@ -504,6 +504,14 @@ class Heartbeater:
         self._stop.set()
         if join and self._thread.is_alive():
             self._thread.join(timeout=10.0)
+
+    def close(self):
+        """One-call teardown: stop the beat loop, then disconnect the
+        underlying MasterClient. client.close() is terminal, so a beat
+        caught mid-reconnect stops at its next attempt instead of
+        re-dialing; the master itself keeps serving other trainers."""
+        self.stop()
+        self.client.close()
 
 
 def task_iterator(client, pass_id, poll_interval=0.1, max_wait=60.0):

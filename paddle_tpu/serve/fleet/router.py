@@ -114,6 +114,21 @@ def _err_body(msg):
     return json.dumps({"error": msg}).encode("utf-8")
 
 
+# connection-scoped headers that must not ride through the router: the
+# router re-frames the body (Content-Length) and owns its own client
+# connections (Connection/Keep-Alive); end-to-end ones (Content-Type,
+# model metadata, Retry-After) pass through
+_HOP_BY_HOP = frozenset({
+    "connection", "content-length", "date", "keep-alive",
+    "proxy-authenticate", "proxy-authorization", "server", "te",
+    "trailer", "transfer-encoding", "upgrade"})
+
+
+def _end_to_end(upstream_headers):
+    return {k: v for k, v in (upstream_headers or {}).items()
+            if k.lower() not in _HOP_BY_HOP}
+
+
 class _attach_maybe:
     """attach(ctx) when tracing gave us one, no-op otherwise."""
 
@@ -238,7 +253,11 @@ class Router:
     def _hedged(self, rep, body, headers, timeout_s, parent_ctx, tried):
         """Race a second replica against a silent first attempt; first
         answer (success OR failure) wins, the loser is reaped off-path so
-        its breaker outcome still lands."""
+        its breaker outcome still lands. The loser's name goes into
+        `tried` — it still holds the request in flight, so a later retry
+        must not resend to it. Total wait stays within timeout_s: the
+        post-hedge wait is what remains of it after the hedge_ms spent
+        listening for the first attempt."""
         results = queue.Queue()
 
         def fire(r, hedge):
@@ -249,6 +268,8 @@ class Router:
                 results.put((r, None, e))
 
         fired = 1
+        second = None
+        t0 = time.perf_counter()
         threading.Thread(target=fire, args=(rep, False),
                          name="fleet-send", daemon=True).start()
         try:
@@ -261,14 +282,19 @@ class Router:
                 fired += 1
                 threading.Thread(target=fire, args=(second, True),
                                  name="fleet-hedge", daemon=True).start()
+            remaining = timeout_s - (time.perf_counter() - t0)
             try:
-                winner = results.get(timeout=timeout_s)
+                winner = results.get(timeout=max(0.0, remaining))
             except queue.Empty:
+                if second is not None:
+                    tried.add(second.name)  # silent, but still in flight
                 raise TimeoutError(
                     f"no answer from {rep.name} within {timeout_s:.3f}s "
                     f"(hedged={fired > 1})") from None
         if fired > 1:
             w_rep, w_out, w_err = winner
+            loser = second if w_rep is rep else rep
+            tried.add(loser.name)
             if w_rep is not rep and w_err is None:
                 self._counter("hedge_wins", "fleet_hedge_wins_total",
                               "hedged requests answered by the hedge")
@@ -328,12 +354,14 @@ class Router:
                     # deterministic answer (2xx/4xx/500): the replica is
                     # functioning — pass it through, close the breaker
                     rep.breaker.record_success()
-                    status, _rh, rb = out
+                    status, rh, rb = out
                     fsp.set(status=status, attempts=attempts,
                             replica=rep.name)
                     self._observe(t_start)
-                    return status, {"X-Fleet-Replica": rep.name,
-                                    "X-Fleet-Attempts": str(attempts)}, rb
+                    out_headers = _end_to_end(rh)
+                    out_headers["X-Fleet-Replica"] = rep.name
+                    out_headers["X-Fleet-Attempts"] = str(attempts)
+                    return status, out_headers, rb
                 if err is not None and not is_transient(err):
                     # programmer/config error on OUR side of the wire —
                     # retrying elsewhere cannot change it
@@ -461,6 +489,9 @@ def make_fleet_http(router, host="127.0.0.1", port=8100):
             self.send_header("Content-Length", str(len(data)))
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
+                if k.lower() == "connection" and v.lower() == "close":
+                    # the header alone is advisory; actually drop keep-alive
+                    self.close_connection = True
             self.end_headers()
             self.wfile.write(data)
 
@@ -489,7 +520,11 @@ def make_fleet_http(router, host="127.0.0.1", port=8100):
             if self.path == "/v1/infer":
                 status, hdrs, rbody = rt.route(body, headers={
                     "Content-Type": "application/json"})
-                self._reply(status, rbody, headers=hdrs)
+                # route() forwards the replica's Content-Type; lift it
+                # out so _reply doesn't emit the header twice
+                ctype = hdrs.pop("Content-Type", "application/json")
+                self._reply(status, rbody, content_type=ctype,
+                            headers=hdrs)
             elif self.path == "/admin/register":
                 try:
                     payload = json.loads(body or b"{}")
@@ -501,11 +536,23 @@ def make_fleet_http(router, host="127.0.0.1", port=8100):
                 self._json(200, {"registered": rep.name,
                                  "state": rep.state})
             elif self.path == "/admin/drain":
+                # validate first: a malformed request is a 400, and 404
+                # stays reserved for "well-formed but unknown replica"
                 try:
                     payload = json.loads(body or b"{}")
-                    report = rt.drain(str(payload["replica"]))
-                except KeyError as e:
-                    self._json(404, {"error": f"unknown replica: {e}"})
+                except ValueError as e:
+                    self._json(400, {"error": f"bad drain request: {e}"})
+                    return
+                name = payload.get("replica") \
+                    if isinstance(payload, dict) else None
+                if not isinstance(name, str) or not name:
+                    self._json(400, {"error":
+                                     'body must be {"replica": "<name>"}'})
+                    return
+                try:
+                    report = rt.drain(name)
+                except KeyError:
+                    self._json(404, {"error": f"unknown replica: {name!r}"})
                     return
                 except (ValueError, TypeError, OSError) as e:
                     self._json(500, {"error": str(e)})

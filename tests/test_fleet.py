@@ -389,6 +389,71 @@ def test_router_hedge_fires_and_first_answer_wins():
     assert snap["fleet_hedge_wins_total"] == 1
 
 
+def test_router_hedge_loser_joins_tried_set():
+    """A hedge loser still holds the request in flight: a later retry
+    must pick a THIRD replica, not resend to the silent first one."""
+    calls, lock = [], threading.Lock()
+
+    def transport(ep, path, body, headers, timeout_s):
+        # behavior by order of FIRST contact: slug sleeps, the hedge
+        # answers 503 (retryable), the retry target answers 200
+        with lock:
+            calls.append(ep)
+            idx = list(dict.fromkeys(calls)).index(ep)
+        if idx == 0:
+            time.sleep(0.5)
+            return 200, {}, b'{"who":"slug"}'
+        if idx == 1:
+            return 503, {}, b'{"error":"full"}'
+        return 200, {}, b'{"who":"third"}'
+
+    r = _router(transport, hedge_ms=20.0)
+    status, hdrs, body = r.route(b"{}")
+    assert status == 200 and json.loads(body)["who"] == "third"
+    slug = calls[0]
+    assert calls.count(slug) == 1  # never retried onto the busy loser
+    assert len(set(calls)) == 3
+
+
+def test_router_hedged_attempt_respects_deadline():
+    """The post-hedge wait is the attempt timeout MINUS the hedge_ms
+    already spent listening — a silent fleet answers at ~deadline, not
+    deadline + hedge_ms (regression: the second wait used to restart the
+    full attempt timeout)."""
+    def transport(ep, path, body, headers, timeout_s):
+        time.sleep(2.0)  # everyone silent far past the deadline
+        return 200, {}, b"{}"
+
+    r = _router(transport, hedge_ms=200.0, request_deadline_ms=300.0,
+                max_attempts=1)
+    t0 = time.perf_counter()
+    status, _, _ = r.route(b"{}")
+    dt = time.perf_counter() - t0
+    assert status == 503  # one transient TimeoutError, no attempts left
+    # old behavior waited hedge(0.2s) + full timeout(0.3s) ~= 0.5s
+    assert dt < 0.45, f"hedged attempt overran the deadline: {dt:.3f}s"
+
+
+def test_router_success_forwards_end_to_end_headers():
+    def transport(ep, path, body, headers, timeout_s):
+        return 200, {"Content-Type": "application/x-custom",
+                     "X-Model-Version": "7", "Content-Length": "2",
+                     "Connection": "keep-alive", "Date": "whenever",
+                     "Server": "replica"}, b"ok"
+
+    r = _router(transport, n=1)
+    status, hdrs, body = r.route(b"{}")
+    assert status == 200 and body == b"ok"
+    # end-to-end headers ride through with the fleet annotations...
+    assert hdrs["Content-Type"] == "application/x-custom"
+    assert hdrs["X-Model-Version"] == "7"
+    assert hdrs["X-Fleet-Replica"] == "r0"
+    assert hdrs["X-Fleet-Attempts"] == "1"
+    # ...connection-scoped ones stay on the router<->replica hop
+    for k in ("Content-Length", "Connection", "Date", "Server"):
+        assert k not in hdrs
+
+
 def test_router_trace_headers_propagate(monkeypatch):
     from paddle_tpu import flags, trace
 
@@ -603,6 +668,123 @@ def test_fleet_http_healthz_503_when_no_replicas():
     finally:
         fhttpd.shutdown()
         fhttpd.server_close()
+
+
+def test_fleet_http_drain_bad_request_vs_unknown_replica():
+    """400 for a malformed drain payload, 404 ONLY for a well-formed
+    request naming a replica the membership doesn't know."""
+    router = Router(config=FleetConfig())
+    fhttpd = make_fleet_http(router, port=0)
+    port = fhttpd.server_address[1]
+    threading.Thread(target=fhttpd.serve_forever, daemon=True).start()
+    try:
+        def post(data):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/admin/drain", data=data)
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    return resp.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        assert post(b"{}") == 400             # missing "replica" key
+        assert post(b"not json") == 400       # unparseable body
+        assert post(b'{"replica": 7}') == 400  # wrong type
+        assert post(b'[1, 2]') == 400         # not an object
+        assert post(b'{"replica": "ghost"}') == 404  # unknown name
+    finally:
+        fhttpd.shutdown()
+        fhttpd.server_close()
+
+
+def test_cli_replica_master_sigterm_drains_and_exits_clean(
+        tmp_path, monkeypatch):
+    """The --master replica's whole shutdown path: SIGTERM drains the
+    backlog BEFORE the HTTP loop stops, the Heartbeater + MasterClient
+    close without error (regression: the CLI finally-block used to raise
+    AttributeError reaching the client), and the process-equivalent
+    returns 0 with empty queues while the master keeps serving."""
+    import signal as _signal
+
+    from paddle_tpu.cli import main as cli_main
+    from paddle_tpu.parallel.master import MasterClient, MasterService
+
+    prog, startup, y = _fc_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    model_dir = tmp_path / "model"
+    with fluid.program_guard(prog, startup):
+        fluid.io.save_inference_model(str(model_dir), ["x"], [y], exe)
+
+    svc = MasterService(chunks_per_task=1)
+    mport = svc.serve()
+    captured = {}
+    monkeypatch.setattr(  # signal.signal only works on the main thread
+        _signal, "signal",
+        lambda signum, handler: captured.__setitem__(signum, handler))
+
+    pf = tmp_path / "port"
+    rc = []
+    t = threading.Thread(target=lambda: rc.append(cli_main(
+        ["fleet", "replica", "--model-dir", str(model_dir),
+         "--place", "cpu", "--port", "0", "--port-file", str(pf),
+         "--name", "hb0", "--master", f"127.0.0.1:{mport}",
+         "--ttl", "1.0"])), daemon=True)
+    probe = MasterClient(f"127.0.0.1:{mport}")
+    try:
+        t.start()
+        deadline = time.time() + 120
+        while not pf.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        endpoint = f"127.0.0.1:{pf.read_text().strip()}"
+        while "hb0" not in probe.lookup("serve") \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        assert probe.lookup("serve") == {"hb0": endpoint}
+        assert _signal.SIGTERM in captured
+
+        codes, lock = [], threading.Lock()
+
+        def client():
+            req = urllib.request.Request(
+                f"http://{endpoint}/v1/infer", data=_BODY,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    code = resp.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+            except urllib.error.URLError:
+                code = "refused"  # listener already gone: never accepted
+            with lock:
+                codes.append(code)
+
+        client()  # before the drain: the replica serves
+        assert codes == [200]
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        for th in threads:
+            th.start()
+        captured[_signal.SIGTERM](_signal.SIGTERM, None)
+        for th in threads:
+            th.join(timeout=30)
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert rc == [0]  # drained clean: empty queues, no teardown crash
+        # every request racing the drain resolved: 200 for accepted work,
+        # 503 (draining) or a refused connect for rejected admissions —
+        # an ACCEPTED request is never dropped
+        assert len(codes) == 9 and set(codes) <= {200, 503, "refused"}
+        # the master survived its client's departure...
+        assert isinstance(probe.counts(), dict)
+        # ...and the lease lapses now that the beats stopped
+        deadline = time.time() + 10
+        while probe.lookup("serve") and time.time() < deadline:
+            time.sleep(0.1)
+        assert probe.lookup("serve") == {}
+    finally:
+        probe.close()
+        svc.stop()
+        t.join(timeout=10)
 
 
 # ---------------------------------------------------------------------------

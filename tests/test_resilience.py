@@ -801,6 +801,43 @@ def test_heartbeater_keeps_ttl_registration_alive():
         svc.stop()
 
 
+def test_heartbeater_close_stops_beats_and_disconnects_client():
+    """The CLI replica's --master teardown path: one close() call stops
+    the beat thread AND disconnects the MasterClient (regression: the
+    client used to be reachable only as a private attribute, so the CLI
+    finally-block raised AttributeError on every --master exit)."""
+    from paddle_tpu.parallel import rpc as _rpc
+    from paddle_tpu.parallel.master import (Heartbeater, MasterClient,
+                                            MasterService)
+
+    svc = MasterService(chunks_per_task=1)
+    port = svc.serve()
+    c = MasterClient(f"127.0.0.1:{port}")
+    hb = Heartbeater(c, "serve", "r0", "127.0.0.1:9001", ttl=0.4)
+    try:
+        hb.start()
+        deadline = time.time() + 10
+        while c.lookup("serve") == {} and time.time() < deadline:
+            time.sleep(0.02)
+        assert c.lookup("serve") == {"r0": "127.0.0.1:9001"}
+        assert hb.client is c  # the public handle the CLI closes
+        hb.close()
+        assert not hb._thread.is_alive()
+        assert c._sock is None  # disconnected, nothing leaked
+        with pytest.raises(_rpc.RpcError, match="closed"):
+            c.counts()  # terminal: no silent re-dial after close
+        # the master itself is still serving other clients
+        c2 = MasterClient(f"127.0.0.1:{port}")
+        try:
+            assert isinstance(c2.counts(), dict)
+        finally:
+            c2.close()
+    finally:
+        hb.stop()
+        c.close()
+        svc.stop()
+
+
 # -- monitor counters ---------------------------------------------------
 
 
