@@ -93,6 +93,13 @@ PIPELINE_CHUNKS = int(os.environ.get(
 FEED_CAPACITY = int(os.environ.get("BENCH_FEED_CAPACITY", 4))
 TRANSFER_THREADS = int(os.environ.get("BENCH_TRANSFER_THREADS", 4))
 DECODE_WORKERS = int(os.environ.get("BENCH_DECODE_WORKERS", 2))
+# decode in worker PROCESSES (ProcessPoolMap; no GIL ceiling) — fused with
+# the device stage through the shared-memory staging ring. Default on;
+# BENCH_DECODE_PROCESSES=0 falls back to the threaded ParallelMap.
+DECODE_PROCESSES = os.environ.get("BENCH_DECODE_PROCESSES", "1") != "0"
+# per-device prefetch depth (staged chunks ready ahead of the consumer);
+# 0 = the FLAGS_datapipe_prefetch_depth default (2, classic double buffer)
+PREFETCH_DEPTH = int(os.environ.get("BENCH_PREFETCH_DEPTH", 0))
 
 
 def _build_train_program(fluid):
@@ -221,15 +228,23 @@ def _decode_record_f32(rec):
 
 
 def _build_pipe(fluid, path, K, stage_fn=None, decode=_decode_record,
-                wire=None):
+                wire=None, processes=None):
     """The bench input pipe: sharded RecordIO source -> parallel decode ->
     async chunked device staging. batch_read=2 keeps the read-ahead small
-    (each pre-batched record is ~19 MB)."""
+    (each pre-batched record is ~19 MB). With processes=True (the
+    BENCH_DECODE_PROCESSES default) decode runs in worker processes and
+    fuses with the device stage through the shm staging ring — zero
+    host-side copies between decode and device_put. stage_fn forces the
+    threaded path (the fused ring has no host-chunk interception point)."""
+    processes = DECODE_PROCESSES if processes is None else processes
+    if stage_fn is not None:
+        processes = False
+    capacity = PREFETCH_DEPTH or FEED_CAPACITY
     return (fluid.datapipe.DataPipe
             .from_recordio(path, batch_read=2)
-            .map(decode, num_workers=DECODE_WORKERS)
+            .map(decode, num_workers=DECODE_WORKERS, processes=processes)
             .prefetch_to_device(place=fluid.TPUPlace(0), chunk=K,
-                                capacity=FEED_CAPACITY,
+                                capacity=capacity,
                                 transfer_threads=TRANSFER_THREADS,
                                 stage_fn=stage_fn, wire=wire))
 
@@ -312,10 +327,16 @@ def measure_pipeline(fluid):
     link_mbps = probe.nbytes / 1e6 / (time.time() - t)
     del staged_probe, probe
 
+    from paddle_tpu import flags
+
+    # uint8 images on the wire by default (4x fewer link bytes; the
+    # cast+/255 decode fuses into the compiled scan) — FLAGS_wire_compress=0
+    # is the opt-out that ships host-normalized float32 instead
+    u8_wire = (fluid.datapipe.WireSpec.uint8_images("data")
+               if flags.get("wire_compress") else None)
     formats = {
         "float32": dict(decode=_decode_record_f32, wire=None),
-        "uint8": dict(decode=_decode_record_data,
-                      wire=fluid.datapipe.WireSpec.uint8_images("data")),
+        "uint8": dict(decode=_decode_record_data, wire=u8_wire),
     }
     wire_report = {}
     u8_img_s, u8_stats = None, None
@@ -643,6 +664,98 @@ def measure_fleet(fluid, place=None):
     return report
 
 
+# CI-sized fused-pipeline proof (bench.py --dry): tiny uint8 features
+# through the REAL process-decode -> shm-ring -> device-feed path, A/B'd
+# against the same program on device-resident feeds.
+DRY_PIPE_BATCH, DRY_PIPE_FEAT = 16, 192
+
+
+def _dry_pipe_decode(i):
+    rs = np.random.RandomState(i)
+    return {
+        "x": rs.randint(0, 256, (DRY_PIPE_BATCH, DRY_PIPE_FEAT),
+                        dtype=np.uint8),
+        "label": rs.randint(0, 8, (DRY_PIPE_BATCH, 1)).astype(np.int64),
+    }
+
+
+def measure_dry_pipeline(fluid):
+    """The --dry pipeline block: a fused ProcessPoolMap pipe (decode in
+    worker processes, staged through the shared-memory ring, uint8 on the
+    wire via auto-wire) driving exe.run(iters=K), against a device-resident
+    baseline of the same program. Emits the same pipeline_* keys as the
+    real bench so green_gate.sh can assert the plumbing — bottleneck
+    attribution present, pipe keeps up with the device, no leaked shm."""
+    import jax
+
+    from paddle_tpu import datapipe
+
+    K, warm, chunks = 4, 3, 10
+    batch, feat = DRY_PIPE_BATCH, DRY_PIPE_FEAT
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[feat], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        net = fluid.layers.fc(input=x, size=256, act="relu")
+        logits = fluid.layers.fc(input=net, size=8)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=1e-4).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+
+        # baseline: feeds already on device — pure compute + dispatch
+        rs = np.random.RandomState(0)
+        resident = {
+            "x": jax.device_put(
+                rs.randint(0, 256, (K, batch, feat)).astype(np.float32)),
+            "label": jax.device_put(
+                rs.randint(0, 8, (K, batch, 1)).astype(np.int32)),
+        }
+        for _ in range(warm):
+            exe.run(prog, feed=resident, fetch_list=[loss], iters=K)
+        t0 = time.perf_counter()
+        for _ in range(chunks):
+            out = exe.run(prog, feed=resident, fetch_list=[loss], iters=K)
+        np.asarray(out[0])
+        device_img_s = batch * K * chunks / (time.perf_counter() - t0)
+
+        # the real input path: process decode fused with device staging
+        pipe = (datapipe.DataPipe(range((warm + chunks) * K))
+                .map(_dry_pipe_decode, num_workers=2, processes=True)
+                .prefetch_to_device(place=fluid.CPUPlace(), chunk=K,
+                                    capacity=3))
+        lv, n, t0 = None, 0, None
+        for i in range(warm + chunks):
+            if i == warm:
+                t0 = time.perf_counter()
+            out = exe.run(prog, feed=pipe, fetch_list=[loss], iters=K)
+            lv = float(np.asarray(out[0]).reshape(-1)[-1])
+            if t0 is not None:
+                n += 1
+        dt = time.perf_counter() - t0
+        st = pipe.stats()
+        wire = pipe.wire_spec
+        pipe.close()
+    assert np.isfinite(lv), f"non-finite dry pipeline loss {lv}"
+    pipe_img_s = batch * K * n / dt
+    return {
+        "pipeline_images_per_sec": round(pipe_img_s, 1),
+        "pipeline_device_img_s": round(device_img_s, 1),
+        "pipeline_frac_of_device": round(pipe_img_s / device_img_s, 3),
+        "pipeline_bottleneck_stage": st.get("bottleneck_stage"),
+        "pipeline_stage_ms": {
+            name: round(s["busy_s"] * 1000.0, 1)
+            for name, s in st.items()
+            if isinstance(s, dict) and "busy_s" in s},
+        "pipeline_decode_processes": True,
+        "pipeline_wire": wire.describe() if wire is not None else None,
+        "pipeline_leaked_shm": len(datapipe.live_segments()),
+    }
+
+
 # ResNet-50 at 224x224 is ~4.1 GFLOPs/image forward; training (fwd + bwd)
 # is conventionally ~3x forward. Used only when no HLO cost was captured.
 ANALYTIC_RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.1e9
@@ -766,6 +879,12 @@ def measure_dry(fluid):
         "off_delta_frac": round(delta, 4),
         "off_delta_ok": delta <= 0.01 or abs(off2_ms - off1_ms) <= 0.25,
     }
+    # fused input pipeline, CI-sized: process decode + shm staging driving
+    # the same exe.run(iters=K) path — the keys green_gate.sh asserts
+    try:
+        result["pipeline"] = measure_dry_pipeline(fluid)
+    except Exception as e:
+        result["pipeline_error"] = f"{type(e).__name__}: {e}"
     # serving mode, CI-sized: the same A/B the full --serve run does
     # (unbatched vs Server QPS, percentiles, zero-steady-compile check);
     # runs AFTER the cache snapshot above because it resets the monitor
@@ -851,6 +970,15 @@ def main():
             result["pipeline_stage_busy_s"] = {
                 name: s["busy_s"] for name, s in stats.items()
                 if isinstance(s, dict) and "busy_s" in s}
+            # the named verdict: per-stage busy ms and which stage to
+            # optimize next (max busy, device link lanes excluded)
+            result["pipeline_stage_ms"] = {
+                name: round(s["busy_s"] * 1000.0, 1)
+                for name, s in stats.items()
+                if isinstance(s, dict) and "busy_s" in s}
+            result["pipeline_bottleneck_stage"] = stats.get(
+                "bottleneck_stage")
+            result["pipeline_decode_processes"] = DECODE_PROCESSES
             tr = stats.get("transfer", {})
             result["pipeline_transfer_MBps"] = tr.get("MB_per_sec", 0.0)
             result.pop("pipeline_error", None)

@@ -1,17 +1,21 @@
 """paddle_tpu.datapipe — parallel prefetching input pipeline.
 
 A tf.data/Grain-class subsystem that keeps the device fed: sharded
-seekable sources, threaded decode with bounded order-preserving queues,
-preallocated staging-buffer batching, and background host->device transfer
-with double buffering — each stage instrumented (queue depths, busy/wait
-ratios) through the profiler.
+seekable sources, threaded OR process-parallel decode with bounded
+order-preserving queues, preallocated staging-buffer batching, and
+background host->device transfer with double buffering — each stage
+instrumented (queue depths, busy/wait ratios) through the profiler.
 
     from paddle_tpu import datapipe
     pipe = (datapipe.DataPipe.from_recordio(path, parse_fn=parse)
-            .map(decode, num_workers=4)
-            .batch(128)
+            .map(decode, num_workers=4, processes=True)
             .prefetch_to_device(chunk=10, capacity=4))
     exe.run(program, feed=pipe, fetch_list=[loss])
+
+map(processes=True) runs decode in worker processes (no GIL ceiling);
+wired directly before prefetch_to_device(chunk=K) the two stages fuse
+through a shared-memory ring of wire-dtype chunk buffers — zero
+host-side copies between decode and the device link.
 
 See docs/datapipe.md for the design and the stage-level semantics.
 """
@@ -20,19 +24,24 @@ from .batcher import Batcher
 from .feeder import AsyncDeviceFeeder
 from .parallel_map import ParallelMap
 from .pipeline import DataPipe
+from .process_map import DataPipeError, ProcessPoolMap
+from .shm import (SEGMENT_PREFIX, SHM_SLOT_KEY, ShmRing, ShmRingClient,
+                  SlotLease, live_segments)
 from .source import (GeneratorSource, RecordIOSource, Source,
                      default_shard_assignment)
 from .stats import PipeStats, StageStats
 from .transfer import (DONATE_KEY, WIRE_KEY, WireFormat, WireSpec,
-                       pop_markers)
+                       auto_wire, pop_markers)
 
 __all__ = [
     "DataPipe",
+    "DataPipeError",
     "Source",
     "GeneratorSource",
     "RecordIOSource",
     "default_shard_assignment",
     "ParallelMap",
+    "ProcessPoolMap",
     "Batcher",
     "AsyncDeviceFeeder",
     "PipeStats",
@@ -41,5 +50,12 @@ __all__ = [
     "WireSpec",
     "WIRE_KEY",
     "DONATE_KEY",
+    "SHM_SLOT_KEY",
+    "SEGMENT_PREFIX",
+    "ShmRing",
+    "ShmRingClient",
+    "SlotLease",
+    "live_segments",
+    "auto_wire",
     "pop_markers",
 ]

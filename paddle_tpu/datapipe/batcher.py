@@ -43,6 +43,10 @@ class Batcher:
     def _alloc_ring(self, sample):
         rings = {}
         for name, arr in sample.items():
+            if name.startswith("__"):
+                # stage metadata (shm leases, wire markers) is per-item,
+                # not batchable — consumed below, never staged
+                continue
             arr = np.asarray(arr)
             rings[name] = [
                 np.empty((self._bs,) + arr.shape, arr.dtype)
@@ -88,13 +92,18 @@ class Batcher:
             tb = time.perf_counter()
             if rings is None:
                 rings = self._alloc_ring(sample)
+            lease = sample.get("__shm_slot__")
             for name, arr in sample.items():
+                if name.startswith("__"):
+                    continue
                 try:
                     rings[name][slot][fill] = arr
                 except KeyError:
                     raise KeyError(
                         f"sample slot {name!r} not in the first sample's "
                         f"slots {sorted(rings)}") from None
+            if lease is not None:
+                lease.release()  # copied out: the shm slot may be refilled
             fill += 1
             if st:
                 st.busy_s += time.perf_counter() - tb

@@ -38,6 +38,7 @@ import numpy as np
 
 from .. import trace as _trace
 from ..flags import define, get as get_flag
+from .shm import SHM_SLOT_KEY
 from .transfer import DONATE_KEY, WIRE_KEY
 
 __all__ = ["AsyncDeviceFeeder"]
@@ -45,6 +46,11 @@ __all__ = ["AsyncDeviceFeeder"]
 define("datapipe_transfer_threads", int, 0,
        "Parallel host->device transfer threads for datapipe "
        "AsyncDeviceFeeder (0 = auto: min(capacity, 2)).")
+define("datapipe_prefetch_depth", int, 0,
+       "Default staged-chunks-in-flight capacity for AsyncDeviceFeeder "
+       "when the pipe doesn't pass one explicitly (0 = 2: double "
+       "buffer). Deeper prefetch rides out decode jitter at the cost of "
+       "one chunk of device memory per extra level.")
 
 
 class _End:
@@ -100,12 +106,14 @@ class AsyncDeviceFeeder:
     extra XLA compile), matching DeviceChunkFeeder.
     """
 
-    def __init__(self, source, chunk=None, place=None, capacity=2,
+    def __init__(self, source, chunk=None, place=None, capacity=None,
                  transfer_threads=None, stage_fn=None, wire=None,
                  donate=None, stack_stats=None, transfer_stats=None,
-                 link_stats=None):
+                 link_stats=None, wire_cb=None):
         if chunk is not None and int(chunk) < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if capacity is None:
+            capacity = get_flag("datapipe_prefetch_depth") or 2
         if int(capacity) < 2:
             raise ValueError(
                 f"capacity must be >= 2 (double buffer), got {capacity}")
@@ -128,6 +136,7 @@ class AsyncDeviceFeeder:
         self._stack_stats = stack_stats
         self._transfer_stats = transfer_stats
         self._link_stats = link_stats
+        self._wire_cb = wire_cb  # called once with a resolved "auto" spec
         self._active = None  # stop flag of the live iteration (for close())
 
     def _device(self):
@@ -179,7 +188,23 @@ class AsyncDeviceFeeder:
                  "threads": ()}
         self._active = state
         sst, tst = self._stack_stats, self._transfer_stats
-        wire = self._wire
+        # wire may be "auto": resolved from the first pulled item (under
+        # the source lock, so exactly once) via transfer.auto_wire
+        wire_state = {"wire": self._wire,
+                      "pending": self._wire == "auto"}
+
+        def eff_wire(item):
+            if wire_state["pending"]:
+                from .transfer import auto_wire
+
+                wire_state["wire"] = auto_wire(item)
+                wire_state["pending"] = False
+                if self._wire_cb is not None:
+                    try:
+                        self._wire_cb(wire_state["wire"])
+                    except Exception:
+                        pass
+            return wire_state["wire"]
         # consumer-thread trace context, attached inside each transfer
         # worker (explicit cross-thread propagation); snapshot of the
         # flag so workers don't re-read it per chunk
@@ -204,8 +229,13 @@ class AsyncDeviceFeeder:
 
         def pull_chunk(buf_holder):
             """Under the source lock: pull K batches, copy them into this
-            worker's staging buffers. Returns (idx, stacked) or None at
-            EOF/stop. The copy-under-lock is the zero-copy ring boundary."""
+            worker's staging buffers. Returns (idx, stacked, lease, w) or
+            None at EOF/stop — `lease` is the upstream shm SlotLease when
+            the item came out of a fused ProcessPoolMap (released by the
+            caller once the transfer is done), `w` the effective WireSpec
+            for the emitted chunk's markers. The copy-under-lock is the
+            zero-copy ring boundary."""
+            lease = None
             with src_lock:
                 if state["eof_at"] is not None or state["error"] is not None \
                         or state["stop"]:
@@ -221,8 +251,15 @@ class AsyncDeviceFeeder:
                             with cond:
                                 cond.notify_all()
                             return None
-                        if wire is not None:
-                            item = wire.encode_feed(item)
+                        w = eff_wire(item)
+                        if isinstance(item, dict) and SHM_SLOT_KEY in item:
+                            # fused upstream: arrays are shm views already
+                            # in wire dtype; hold the slot until the
+                            # device owns the bytes
+                            lease = item.pop(SHM_SLOT_KEY)
+                            w = item.pop(WIRE_KEY, None) or w
+                        elif w is not None:
+                            item = w.encode_feed(item)
                         # copy when device_put would alias the host array
                         # (the upstream reader may reuse it between items)
                         stacked = {n: np.asarray(a) if puts_copy
@@ -234,6 +271,7 @@ class AsyncDeviceFeeder:
                     else:
                         got = 0
                         buf = buf_holder[0]
+                        w = wire_state["wire"]
                         while got < K:
                             t0 = time.perf_counter()
                             item = next(src, _End)
@@ -246,6 +284,7 @@ class AsyncDeviceFeeder:
                                 with cond:
                                     cond.notify_all()
                                 return None
+                            w = eff_wire(item)
                             tb = time.perf_counter()
                             if buf is None:
                                 # __valid__ (the Batcher's pad mask) is a
@@ -257,13 +296,13 @@ class AsyncDeviceFeeder:
                                             and n != "__valid__":
                                         continue
                                     a = np.asarray(a)
-                                    dt = wire.wire_dtype(n, a) \
-                                        if wire is not None else a.dtype
+                                    dt = w.wire_dtype(n, a) \
+                                        if w is not None else a.dtype
                                     buf[n] = np.empty((K,) + a.shape, dt)
                             for n, b in buf.items():
                                 v = item[n]
-                                if wire is not None and n in wire:
-                                    v = wire[n].encode(v)
+                                if w is not None and n in w:
+                                    v = w[n].encode(v)
                                 b[got] = v
                             got += 1
                             if sst:
@@ -277,11 +316,13 @@ class AsyncDeviceFeeder:
                         else:
                             stacked = {n: b.copy() for n, b in buf.items()}
                 except BaseException as e:
+                    if lease is not None:
+                        lease.release()
                     fail(e)
                     return None
                 idx = state["next_in"]
                 state["next_in"] += 1
-                return idx, stacked
+                return idx, stacked, lease, w
 
         def work(lst):
             if tracing:
@@ -297,15 +338,21 @@ class AsyncDeviceFeeder:
             buf_holder = [None]
             try:
                 while not state["stop"]:
+                    tw = time.perf_counter()
+                    waited = False
                     while not tickets.acquire(timeout=0.2):
                         if state["stop"]:
                             return
+                        waited = True
+                    if waited and tst:
+                        # prefetch budget full: downstream backpressure
+                        tst.add_bp_wait(time.perf_counter() - tw)
                     tp = time.perf_counter()
                     nxt = pull_chunk(buf_holder)
                     if nxt is None:
                         tickets.release()
                         return
-                    idx, stacked = nxt
+                    idx, stacked, lease, w = nxt
                     if tracing:
                         _trace.record("datapipe.stack", tp,
                                       time.perf_counter(), kind="datapipe",
@@ -346,16 +393,22 @@ class AsyncDeviceFeeder:
                         # transfer-engine metadata: the executor pops both
                         # (pop_markers); stage_fn chunks are callee-owned,
                         # so copy before annotating and never mark donate
-                        if wire is not None or self._donate:
+                        if w is not None or self._donate:
                             if self._stage_fn is not None:
                                 staged = dict(staged)
-                            if wire is not None:
-                                staged[WIRE_KEY] = wire
+                            if w is not None:
+                                staged[WIRE_KEY] = w
                             if self._donate:
                                 staged[DONATE_KEY] = True
                     except BaseException as e:
                         fail(e)
                         return
+                    finally:
+                        if lease is not None:
+                            # device owns the bytes (block_until_ready in
+                            # stage(), or the host copy when puts_copy is
+                            # False): the shm slot may be refilled
+                            lease.release()
                     with cond:
                         done[idx] = staged
                         cond.notify_all()

@@ -27,8 +27,10 @@ pulled, which is the ring-reuse boundary batcher.py documents.
 """
 
 from .. import trace as _trace
+from ..flags import get as get_flag
 from .batcher import Batcher
 from .parallel_map import ParallelMap
+from .process_map import ProcessPoolMap
 from .source import GeneratorSource, RecordIOSource, SkipSource, Source
 from .stats import PipeStats
 
@@ -72,6 +74,7 @@ class DataPipe:
         self._pass_emitted = 0      # items yielded to the consumer this pass
         self._resume_base = 0       # records skipped at this pass's build
         self._resume_records = None  # pending skip for the NEXT build
+        self._resolved_wire = None   # wire="auto" resolution, once built
 
     # -- construction ----------------------------------------------------
     @classmethod
@@ -104,12 +107,26 @@ class DataPipe:
         p._stage_memo = self._stage_memo
         return p
 
-    def map(self, fn, num_workers=2, buffer_size=None, order=True):
+    def map(self, fn, num_workers=2, buffer_size=None, order=True,
+            processes=False):
         """Apply fn to every sample on num_workers threads (bounded,
-        order-preserving unless order=False)."""
+        order-preserving unless order=False).
+
+        processes=True runs the map in worker PROCESSES instead
+        (ProcessPoolMap) — pure-Python decode that holds the GIL scales
+        past the thread ceiling; an int is shorthand for
+        processes=True, num_workers=N. When a process map is wired
+        DIRECTLY in front of prefetch_to_device(chunk=K) the two stages
+        fuse: workers decode straight into a shared-memory ring of
+        wire-dtype chunk buffers and the feeder hands those views to
+        device_put — zero host-side copies between decode and link."""
+        if processes and not isinstance(processes, bool):
+            num_workers = int(processes)
+            processes = True
         return self._derive(("map", dict(fn=fn, num_workers=num_workers,
                                          buffer_size=buffer_size,
-                                         order=order)))
+                                         order=order,
+                                         processes=bool(processes))))
 
     def batch(self, batch_size, drop_remainder=True, pad_to_batch=False,
               ring=2):
@@ -120,18 +137,24 @@ class DataPipe:
                                            pad_to_batch=pad_to_batch,
                                            ring=ring)))
 
-    def prefetch_to_device(self, place=None, chunk=None, capacity=2,
-                           transfer_threads=None, stage_fn=None, wire=None,
-                           donate=None):
+    def prefetch_to_device(self, place=None, chunk=None, capacity=None,
+                           transfer_threads=None, stage_fn=None,
+                           wire="auto", donate=None):
         """Terminal stage: background host->device staging (see
         AsyncDeviceFeeder). chunk=K stacks K batches per staged item for
         Executor.run(iters=K); Executor reads K off .feed_iters.
+        capacity=None reads FLAGS_datapipe_prefetch_depth (0 = 2, the
+        double buffer); deeper prefetch rides out decode jitter.
 
         wire=WireSpec(...) ships covered feeds in their compressed wire
         dtype (uint8 pixels cut link bytes 4x vs float32) and the executor
-        fuses the cast+normalize decode into the compiled step; donate
-        marks staged chunks single-use so their device buffers are donated
-        back to XLA across dispatches (None = auto, see AsyncDeviceFeeder).
+        fuses the cast+normalize decode into the compiled step. The
+        default "auto" covers every uint8 feed with a pass-through uint8
+        wire (numerically identical to the host cast it replaces;
+        FLAGS_wire_compress=0 disables); wire=None ships everything
+        uncompressed. donate marks staged chunks single-use so their
+        device buffers are donated back to XLA across dispatches (None =
+        auto, see AsyncDeviceFeeder).
         """
         return self._derive(("device", dict(place=place, chunk=chunk,
                                             capacity=capacity,
@@ -152,11 +175,18 @@ class DataPipe:
     @property
     def wire_spec(self):
         """The prefetch_to_device stage's WireSpec (None when the pipe
-        ships feeds uncompressed)."""
+        ships feeds uncompressed). "auto" reports the spec resolved from
+        the first sample once iteration has started, None before."""
         for kind, kw in self._ops:
             if kind == "device":
-                return kw.get("wire")
+                w = kw.get("wire")
+                if w == "auto":
+                    return self._resolved_wire
+                return w
         return None
+
+    def _set_resolved_wire(self, spec):
+        self._resolved_wire = spec
 
     def _stage(self, i, name):
         if (i, name) not in self._stage_memo:
@@ -179,9 +209,41 @@ class DataPipe:
             self._resume_records = None
         layers, objs = [], []
         cur = src
+        fused_map = None  # index of a map op fused into the next device op
         for i, (kind, kw) in enumerate(self._ops):
             if kind == "map":
-                obj = ParallelMap(cur, stats=self._stage(i, "map"), **kw)
+                kw2 = dict(kw)
+                procs = kw2.pop("processes", False)
+                if procs:
+                    nxt = (self._ops[i + 1]
+                           if i + 1 < len(self._ops) else None)
+                    # fusion: process map feeding prefetch_to_device(K)
+                    # directly — workers decode into a shared-memory ring
+                    # of [K, ...] wire-dtype chunk slots, the feeder puts
+                    # those views (zero host copies decode -> link)
+                    fuse = bool(nxt and nxt[0] == "device"
+                                and nxt[1]["chunk"] is not None
+                                and nxt[1].get("stage_fn") is None)
+                    if fuse:
+                        dkw = nxt[1]
+                        cap = dkw.get("capacity")
+                        if cap is None:
+                            cap = get_flag("datapipe_prefetch_depth") or 2
+                        obj = ProcessPoolMap(
+                            cur, chunk=int(dkw["chunk"]),
+                            wire=dkw.get("wire"),
+                            # one assembling + the feeder's prefetch
+                            # budget, +1 so release latency never stalls
+                            ring_slots=int(cap) + 2,
+                            wire_cb=self._set_resolved_wire,
+                            stats=self._stage(i, "map"), **kw2)
+                        fused_map = i
+                    else:
+                        obj = ProcessPoolMap(
+                            cur, stats=self._stage(i, "map"), **kw2)
+                else:
+                    obj = ParallelMap(cur, stats=self._stage(i, "map"),
+                                      **kw2)
             elif kind == "batch":
                 nxt = self._ops[i + 1] if i + 1 < len(self._ops) else None
                 zero_copy = bool(nxt and nxt[0] == "device"
@@ -189,6 +251,12 @@ class DataPipe:
                 obj = Batcher(cur, zero_copy=zero_copy,
                               stats=self._stage(i, "batch"), **kw)
             elif kind == "device":
+                kw2 = dict(kw)
+                if fused_map == i - 1:
+                    # the fused map already emits complete wire-encoded
+                    # [K, ...] chunks (with their WIRE_KEY): stage as-is
+                    kw2["chunk"] = None
+                    kw2["wire"] = None
                 obj = AsyncDeviceFeeder(
                     cur, stack_stats=self._stage(i, "stack"),
                     transfer_stats=self._stage(i, "transfer"),
@@ -196,7 +264,8 @@ class DataPipe:
                     # stats() show whether the streams share the link's
                     # bandwidth or serialize on it
                     link_stats=lambda t, _i=i: self._stage(_i, f"link{t}"),
-                    **kw)
+                    wire_cb=self._set_resolved_wire,
+                    **kw2)
             else:  # pragma: no cover - builder invariant
                 raise AssertionError(f"unknown op {kind!r}")
             cur = iter(obj)

@@ -1,0 +1,276 @@
+"""Shared-memory staging rings for the process-parallel decode path.
+
+A ShmRing is a pool of POSIX shared-memory slots. Each slot holds one
+CHUNK of staged feeds — a set of named arrays laid out back-to-back at
+64-byte-aligned offsets inside one `multiprocessing.shared_memory`
+segment, already in their WIRE dtype. Decode workers attach to the
+segments by name (ShmRingClient) and write their results directly into
+`slot[g]` for their assigned (slot, offset); the parent never copies the
+decoded bytes again: AsyncDeviceFeeder hands the slot's views straight to
+`jax.device_put`. That is the "zero host-side copies between decode and
+link" contract of the process pipeline.
+
+Ownership protocol:
+
+  * the PARENT allocates, acquires and releases slots (workers only ever
+    write into a slot the parent assigned them, so no cross-process
+    locking is needed);
+  * a slot is busy from dispatch of its first item until the consumer of
+    the staged chunk calls `SlotLease.release()` — for the fused
+    map->device path that consumer is the feeder, which releases after
+    `device_put` + `block_until_ready` (or after its defensive host copy
+    on aliasing XLA:CPU backends);
+  * `close()` closes and unlinks every segment (idempotent). Worker
+    processes merely close their attachments.
+
+Segment names carry the `ptpipe_` prefix so leaked segments are greppable
+in /dev/shm; a module-level registry (`live_segments()`) backs the
+no-leak pytest fixture and the green-gate smoke.
+"""
+
+import os
+import threading
+
+import numpy as np
+
+__all__ = ["ShmRing", "ShmRingClient", "SlotLease", "SHM_SLOT_KEY",
+           "live_segments", "SEGMENT_PREFIX"]
+
+SHM_SLOT_KEY = "__shm_slot__"  # staged-chunk metadata: its SlotLease
+SEGMENT_PREFIX = "ptpipe"
+
+_ALIGN = 64  # device_put zero-copy wants 64-byte-aligned host buffers
+
+_live_lock = threading.Lock()
+_live = set()  # segment names created (and not yet unlinked) by this proc
+_seq = [0]
+
+
+def live_segments():
+    """Names of shm segments this process created and has not unlinked —
+    must be empty after every test (conftest fixture) and after bench
+    runs (green gate)."""
+    with _live_lock:
+        return sorted(_live)
+
+
+def _register(name):
+    with _live_lock:
+        _live.add(name)
+
+
+def _unregister(name):
+    with _live_lock:
+        _live.discard(name)
+
+
+def _layout(schema):
+    """(offsets, total_size) for {name: (shape, dtype)} laid out
+    back-to-back at _ALIGN boundaries."""
+    offsets, off = {}, 0
+    for name, (shape, dtype) in schema.items():
+        off = (off + _ALIGN - 1) // _ALIGN * _ALIGN
+        offsets[name] = off
+        off += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return offsets, max(off, 1)
+
+
+def _normalize_schema(schema):
+    return {str(n): (tuple(int(d) for d in shape), str(np.dtype(dt)))
+            for n, (shape, dt) in schema.items()}
+
+
+class SlotLease:
+    """Handle to one acquired ring slot, released exactly once by
+    whichever stage consumes the staged chunk (idempotent)."""
+
+    __slots__ = ("_ring", "slot", "_done")
+
+    def __init__(self, ring, slot):
+        self._ring = ring
+        self.slot = slot
+        self._done = False
+
+    def release(self):
+        if not self._done:
+            self._done = True
+            self._ring.release(self.slot)
+
+    def __repr__(self):
+        return f"SlotLease(slot={self.slot}, released={self._done})"
+
+
+class ShmRing:
+    """Parent-side ring of `slots` shared-memory segments, each holding
+    the arrays of `schema` ({name: (shape, dtype)})."""
+
+    def __init__(self, slots, schema, name_hint="ring"):
+        from multiprocessing import shared_memory
+
+        if int(slots) < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.schema = _normalize_schema(schema)
+        self._offsets, self._size = _layout(self.schema)
+        self._segs = []
+        self._names = []
+        for i in range(int(slots)):
+            _seq[0] += 1
+            name = (f"{SEGMENT_PREFIX}_{os.getpid()}_{_seq[0]}_"
+                    f"{name_hint}_{i}")
+            seg = shared_memory.SharedMemory(name=name, create=True,
+                                             size=self._size)
+            _register(seg.name)
+            self._segs.append(seg)
+            self._names.append(seg.name)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._free = list(range(int(slots)))
+        self._closed = False
+
+    @property
+    def slots(self):
+        return len(self._names)
+
+    @property
+    def nbytes(self):
+        return self._size * len(self._names)
+
+    def meta(self):
+        """Picklable attach info for ShmRingClient in worker processes."""
+        return {"names": list(self._names), "schema": dict(self.schema),
+                "offsets": dict(self._offsets)}
+
+    # -- slot pool (parent threads only) --------------------------------
+    def acquire(self, timeout=0.2):
+        """Next free slot index, or None after `timeout` (caller re-polls
+        so stop flags stay responsive)."""
+        with self._cond:
+            if not self._free:
+                self._cond.wait(timeout)
+            if not self._free:
+                return None
+            return self._free.pop()
+
+    def release(self, slot):
+        with self._cond:
+            if not self._closed and slot not in self._free:
+                self._free.append(slot)
+                self._cond.notify()
+
+    def lease(self, slot):
+        return SlotLease(self, slot)
+
+    def views(self, slot):
+        """{name: ndarray} views over one slot's buffer (no copies)."""
+        buf = self._segs[slot].buf
+        out = {}
+        for name, (shape, dtype) in self.schema.items():
+            off = self._offsets[name]
+            out[name] = np.ndarray(shape, dtype=dtype, buffer=buf,
+                                   offset=off)
+        return out
+
+    def close(self):
+        """Close + unlink every segment (idempotent). Call after worker
+        processes are joined; POSIX keeps the memory alive for any
+        straggler mapping until its last close."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        for seg in self._segs:
+            try:
+                seg.close()
+            except Exception:
+                pass
+            try:
+                seg.unlink()
+            except Exception:
+                pass
+            _unregister(seg.name)
+        self._segs = []
+
+
+class _MMapSeg:
+    """Direct mmap of /dev/shm/<name>: the attachment path that does NOT
+    involve multiprocessing.resource_tracker. Attaching via SharedMemory
+    in a worker either double-unregisters the parent's tracker entry
+    (fork: shared tracker process) or unlinks live segments at worker
+    exit (spawn: bpo-39959) — mapping the file directly sidesteps both."""
+
+    __slots__ = ("_f", "_mm", "buf")
+
+    def __init__(self, path):
+        import mmap
+
+        self._f = open(path, "r+b")
+        self._mm = mmap.mmap(self._f.fileno(), 0)
+        self.buf = memoryview(self._mm)
+
+    def close(self):
+        try:
+            self.buf.release()
+        except Exception:
+            pass
+        try:
+            self._mm.close()
+        except Exception:
+            pass
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+
+class ShmRingClient:
+    """Worker-side attachment: lazily opens segments by name and exposes
+    the same views() layout. Workers write, never acquire/release."""
+
+    def __init__(self, meta):
+        self._names = list(meta["names"])
+        self._schema = {n: (tuple(s), d)
+                        for n, (s, d) in meta["schema"].items()}
+        self._offsets = dict(meta["offsets"])
+        self._segs = {}
+
+    def _seg(self, slot):
+        seg = self._segs.get(slot)
+        if seg is None:
+            path = f"/dev/shm/{self._names[slot]}"
+            if os.path.exists(path):
+                seg = _MMapSeg(path)
+            else:  # platforms without /dev/shm, tracker quirks and all
+                from multiprocessing import shared_memory
+
+                seg = shared_memory.SharedMemory(name=self._names[slot])
+            self._segs[slot] = seg
+        return seg
+
+    def views(self, slot):
+        buf = self._seg(slot).buf
+        out = {}
+        for name, (shape, dtype) in self._schema.items():
+            off = self._offsets[name]
+            out[name] = np.ndarray(shape, dtype=dtype, buffer=buf,
+                                   offset=off)
+        return out
+
+    def write(self, slot, index, values, wire=None):
+        """Encode + copy one decoded sample dict into row `index` of slot
+        `slot` — the single host-side copy of the fused decode path.
+        Unknown and '__'-metadata keys are ignored (schema is authority)."""
+        views = self.views(slot)
+        for name, view in views.items():
+            v = values[name]
+            if wire is not None and name in wire:
+                v = wire[name].encode(v)
+            view[index] = v
+
+    def close(self):
+        for seg in self._segs.values():
+            try:
+                seg.close()
+            except Exception:
+                pass
+        self._segs = {}

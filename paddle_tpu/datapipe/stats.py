@@ -29,6 +29,7 @@ class StageStats:
         self.busy_s = 0.0
         self.wait_in_s = 0.0
         self.wait_out_s = 0.0
+        self.bp_wait_s = 0.0  # blocked on capacity tickets (backpressure)
         self._depth_sum = 0
         self._depth_n = 0
         self._t_first = None
@@ -52,6 +53,13 @@ class StageStats:
     def add_wait_out(self, dt):
         with self._lock:
             self.wait_out_s += dt
+
+    def add_bp_wait(self, dt):
+        """Time this stage spent blocked acquiring a capacity ticket —
+        distinct from wait_out (a consumer not showing up): bp_wait means
+        the DOWNSTREAM budget (prefetch depth, ring slots) is full."""
+        with self._lock:
+            self.bp_wait_s += dt
 
     def sample_depth(self, depth):
         with self._lock:
@@ -84,9 +92,13 @@ class StageStats:
                 "busy_s": round(self.busy_s, 6),
                 "wait_in_s": round(self.wait_in_s, 6),
                 "wait_out_s": round(self.wait_out_s, 6),
+                "bp_wait_s": round(self.bp_wait_s, 6),
             }
             if span > 0:
                 d["items_per_sec"] = round(self.items / span, 2)
+                # fraction of the stage's active span spent doing its own
+                # work — ~1.0 marks the pipeline's bottleneck stage
+                d["occupancy"] = round(min(self.busy_s / span, 1.0), 4)
                 if self.bytes:
                     d["MB_per_sec"] = round(self.bytes / 1e6 / span, 2)
             if self._depth_n:
@@ -128,9 +140,27 @@ class PipeStats:
                 s.name: round(out[s.name]["busy_s"] / total_busy, 4)
                 for s in stages
             }
+        bn = self._bottleneck(out)
+        if bn is not None:
+            out["bottleneck_stage"] = bn
         return out
 
-    _DELTA_KEYS = ("items", "bytes", "busy_s", "wait_in_s", "wait_out_s")
+    @staticmethod
+    def _bottleneck(snap):
+        """The stage with the most cumulative busy time — the one to
+        speed up for throughput. Per-lane linkN rows duplicate the
+        transfer stage's busy and are excluded."""
+        best, best_busy = None, 0.0
+        for name, d in snap.items():
+            if not isinstance(d, dict) or "busy_s" not in d \
+                    or name.startswith("link"):
+                continue
+            if d["busy_s"] > best_busy:
+                best, best_busy = name, d["busy_s"]
+        return best
+
+    _DELTA_KEYS = ("items", "bytes", "busy_s", "wait_in_s", "wait_out_s",
+                   "bp_wait_s")
 
     def delta(self):
         """Per-stage counter DELTAS since the previous delta() call — what
@@ -148,4 +178,20 @@ class PipeStats:
                 self._delta_base[s.name] = {
                     k: snap.get(k, 0) for k in self._DELTA_KEYS}
                 out[s.name] = d
+        bn = self._bottleneck(out)
+        if bn is not None:
+            out["bottleneck_stage"] = bn
+        from .. import monitor
+
+        if monitor.enabled():
+            reg = monitor.registry()
+            for name, d in out.items():
+                if not isinstance(d, dict):
+                    continue
+                reg.gauge("datapipe_stage_busy_ms",
+                          help="stage busy time over the last step",
+                          stage=name).set(round(d["busy_s"] * 1e3, 3))
+                reg.gauge("datapipe_stage_bp_wait_ms",
+                          help="stage backpressure wait over the last step",
+                          stage=name).set(round(d["bp_wait_s"] * 1e3, 3))
         return out

@@ -26,11 +26,20 @@ compile-cache key with the spec fingerprint.
 
 import numpy as np
 
+from ..flags import define, get as get_flag
+
 __all__ = ["WireFormat", "WireSpec", "WIRE_KEY", "DONATE_KEY",
-           "pop_markers"]
+           "pop_markers", "auto_wire"]
 
 WIRE_KEY = "__wire__"      # staged-chunk metadata: the chunk's WireSpec
 DONATE_KEY = "__donate__"  # staged-chunk metadata: buffers are single-use
+
+define("wire_compress", bool, True,
+       "Ship compressed wire formats on the host->device link by default "
+       "(uint8 image feeds stay uint8 on the wire; the compiled step "
+       "fuses the cast/normalize). FLAGS_wire_compress=0 reverts to "
+       "uncompressed float feeds everywhere a pipe or bench path asked "
+       "for the default.")
 
 
 def _np_dtype(name):
@@ -198,6 +207,33 @@ class WireSpec:
             return step(mut_state, const_state, feeds, rng)
 
         return wired
+
+
+def auto_wire(sample):
+    """Default WireSpec for a sample dict (`wire="auto"`): every uint8
+    feed rides the link as uint8 and the compiled step casts it to the
+    program variable's declared dtype — numerically identical to the host
+    cast it replaces, at a quarter of the link bytes when the variable is
+    float32. Non-uint8 feeds are left alone (quantizing floats would
+    change numerics, which is an explicit opt-in via WireSpec). Returns
+    None when nothing qualifies or FLAGS_wire_compress=0."""
+    if not get_flag("wire_compress") or not isinstance(sample, dict):
+        return None
+    names = []
+    for n, v in sample.items():
+        if n.startswith("__"):
+            continue
+        try:
+            a = np.asarray(v)
+        except Exception:
+            continue
+        if a.dtype == np.uint8:
+            names.append(n)
+    if not names:
+        return None
+    # pass-through wire + cast-only decode (no affine): the program's
+    # declared var dtype resolves at wrap time
+    return WireSpec({n: WireFormat("uint8") for n in names})
 
 
 def pop_markers(feed):

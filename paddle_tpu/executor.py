@@ -294,7 +294,12 @@ class Executor:
         `feed` may also be a datapipe.DataPipe (anything with next_feed()):
         the executor pulls the next prefetched chunk itself and defaults
         iters to the pipe's chunk size (feed_iters). The pipe's
-        StopIteration propagates when it is exhausted.
+        StopIteration propagates when it is exhausted, and a
+        datapipe.DataPipeError (e.g. a decode worker process died and
+        FLAGS_datapipe_restart_workers is off) propagates from the pull.
+        The wait for the staged chunk is the step's `feed_wait` phase —
+        nonzero time there means the device out-ran the pipe; the per-step
+        record's `datapipe` delta (stats_delta) names the stage to blame.
 
         Transfer-engine markers riding in a staged chunk (datapipe
         WIRE_KEY / DONATE_KEY) are honoured: wire-compressed feeds are

@@ -184,10 +184,13 @@ class ParallelExecutor:
         stacked [K, ...]). Same contract as Executor.run(iters=K).
 
         `feed` may be a datapipe.DataPipe: the next prefetched chunk is
-        pulled here and iters defaults to the pipe's chunk size. Transfer-
-        engine markers (WIRE_KEY/DONATE_KEY) riding a staged chunk are
-        honoured the same way as Executor.run: wire decode fused into the
-        compiled step, single-use chunks donated. `async_fetch=True`
+        pulled here (the step's `feed_wait` phase; DataPipeError from a
+        dead decode worker propagates) and iters defaults to the pipe's
+        chunk size. Transfer-engine markers (WIRE_KEY/DONATE_KEY) riding
+        a staged chunk are honoured the same way as Executor.run: wire
+        decode fused into the compiled step, single-use chunks donated —
+        including chunks staged zero-copy from the process-pool shm ring.
+        `async_fetch=True`
         returns FetchFuture handles instead of host arrays."""
         _apply_debug_nans()
         # single flag check when monitoring is off (same contract as

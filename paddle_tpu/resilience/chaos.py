@@ -12,6 +12,7 @@ raises UNAVAILABLE, twice" and prove the retry path end to end:
     sigterm      os.kill(self, SIGTERM)                    (keyed on global step)
     replica_kill os.kill(self, SIGKILL)                    (keyed on run-call index)
     replica_hang sleep delay_ms, holding the dispatch      (keyed on run-call index)
+    worker_kill  SIGKILL a datapipe decode worker process  (keyed on map-item index)
 
 delay/transient count *executor run calls* because that is what retry
 wraps (a retried step consumes several run-call indices — set `times` to
@@ -38,10 +39,10 @@ from .. import monitor
 from .errors import TransientError
 
 __all__ = ["Fault", "ChaosMonkey", "install", "uninstall", "active",
-           "on_run"]
+           "on_run", "on_map_dispatch"]
 
 _KINDS = ("delay", "transient", "nan", "sigterm", "replica_kill",
-          "replica_hang")
+          "replica_hang", "worker_kill")
 
 # a "hung" replica is dead-but-connected: default far past any sane
 # request deadline so the router's probes, not patience, end the wait
@@ -117,6 +118,17 @@ class ChaosMonkey:
                 self._fire(f, n, label)
                 time.sleep(f.delay_ms / 1000.0)
 
+    def on_map_dispatch(self, n, pid):
+        """ProcessPoolMap hook, called as item `n` is handed to the
+        decode worker `pid`. worker_kill SIGKILLs that worker — an
+        uncatchable mid-batch death, exactly what an OOM-killed decode
+        process looks like — to prove the parent's death detection
+        (DataPipeError or FLAGS_datapipe_restart_workers replay)."""
+        for f in self.faults:
+            if f.kind == "worker_kill" and f._covers(n):
+                self._fire(f, n, "datapipe")
+                os.kill(pid, signal.SIGKILL)
+
     def on_step(self, step):
         """Runner hook, called at each global-step boundary (after the
         step's checkpoint cadence ran)."""
@@ -181,3 +193,10 @@ def on_run(label):
     m = _active[0]
     if m is not None:
         m.on_run(label)
+
+
+def on_map_dispatch(n, pid):
+    """Module-level ProcessPoolMap hook — one list lookup when off."""
+    m = _active[0]
+    if m is not None:
+        m.on_map_dispatch(n, pid)

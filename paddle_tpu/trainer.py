@@ -29,8 +29,12 @@ class BeginEpochEvent:
 
 
 class EndEpochEvent:
-    def __init__(self, epoch_id):
+    def __init__(self, epoch_id, datapipe_stats=None):
         self.epoch = epoch_id
+        # cumulative per-stage datapipe snapshot (busy/wait/backpressure
+        # seconds, occupancy, bottleneck_stage) when the epoch was driven
+        # by a DataPipe — None for reader/DataFeeder epochs
+        self.datapipe_stats = datapipe_stats
 
 
 class BeginStepEvent:
@@ -58,6 +62,15 @@ class CheckpointConfig:
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = epoch_interval
         self.step_interval = step_interval
+
+
+def _pipe_stats(pipe):
+    """Cumulative stage snapshot for EndEpochEvent — never lets a
+    telemetry failure break the epoch boundary."""
+    try:
+        return pipe.stats()
+    except Exception:
+        return None
 
 
 def check_and_get_place(place):
@@ -242,7 +255,8 @@ class Trainer:
                     if monitor_mod.enabled() else None
                 event_handler(EndStepEvent(epoch_id, step_id, metrics,
                                            monitor=snap))
-            event_handler(EndEpochEvent(epoch_id))
+            event_handler(EndEpochEvent(
+                epoch_id, datapipe_stats=_pipe_stats(pipe)))
 
     def _train_by_datapipe_resilient(self, num_epochs, event_handler, pipe,
                                      exe, iters):
@@ -298,7 +312,8 @@ class Trainer:
                     epoch_id = int(runner.state.get("epoch", epoch_id))
                     reseat_rng()
                     continue
-                event_handler(EndEpochEvent(epoch_id))
+                event_handler(EndEpochEvent(
+                    epoch_id, datapipe_stats=_pipe_stats(pipe)))
                 epoch_id += 1
                 # epoch boundary: the next pass starts at record 0
                 runner.state["epoch"] = epoch_id

@@ -54,26 +54,51 @@ def rng():
 
 @pytest.fixture
 def no_datapipe_thread_leaks():
-    """Fail THE TEST (not the session) if it leaks datapipe worker threads
-    (datapipe-map-*/datapipe-feed-* — decode and transfer lanes). Stages
-    reap their daemons on exhaustion and on close(); a survivor means a
-    worker is wedged on a queue. Opt in per module with
-    pytest.mark.usefixtures so unrelated suites don't pay the drain wait."""
+    """Fail THE TEST (not the session) if it leaks datapipe workers:
+    threads (datapipe-map-*/datapipe-feed-* — decode and transfer lanes),
+    child PROCESSES (datapipe-proc-* — ProcessPoolMap decode workers) or
+    shared-memory segments (the ptpipe_* staging rings). Stages reap
+    their daemons on exhaustion and on close(); a survivor means a worker
+    is wedged on a queue, and a surviving shm segment would accumulate in
+    /dev/shm across runs. Opt in per module with pytest.mark.usefixtures
+    so unrelated suites don't pay the drain wait."""
+    import multiprocessing
     import threading
     import time
+
+    from paddle_tpu.datapipe import shm as dp_shm
 
     def _datapipe_threads():
         return {t for t in threading.enumerate()
                 if t.is_alive() and t.name.startswith("datapipe-")}
 
+    def _datapipe_procs():
+        return {p for p in multiprocessing.active_children()
+                if p.name.startswith("datapipe-") and p.is_alive()}
+
     before = _datapipe_threads()
+    before_p = _datapipe_procs()
+    before_s = set(dp_shm.live_segments())
     yield
     deadline = time.time() + 5.0
-    leaked = _datapipe_threads() - before
-    while leaked and time.time() < deadline:
+
+    def _leaks():
+        return (_datapipe_threads() - before,
+                _datapipe_procs() - before_p,
+                set(dp_shm.live_segments()) - before_s)
+
+    leaked_t, leaked_p, leaked_s = _leaks()
+    while (leaked_t or leaked_p or leaked_s) and time.time() < deadline:
         time.sleep(0.05)
-        leaked = _datapipe_threads() - before
-    if leaked:
-        pytest.fail(
-            "leaked datapipe threads: "
-            f"{sorted(t.name for t in leaked)}", pytrace=False)
+        leaked_t, leaked_p, leaked_s = _leaks()
+    msgs = []
+    if leaked_t:
+        msgs.append(f"threads: {sorted(t.name for t in leaked_t)}")
+    if leaked_p:
+        msgs.append(
+            f"processes: {sorted(p.name for p in leaked_p)}")
+    if leaked_s:
+        msgs.append(f"shm segments: {sorted(leaked_s)}")
+    if msgs:
+        pytest.fail("leaked datapipe workers — " + "; ".join(msgs),
+                    pytrace=False)

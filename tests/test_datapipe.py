@@ -344,3 +344,126 @@ def test_feeder_staged_items_do_not_alias_reused_host_buffers():
         src(), place=fluid.CPUPlace(), capacity=2, transfer_threads=1))
     vals = [float(np.asarray(s["x"])[0]) for s in staged]
     assert vals == [0., 1., 2., 3., 4., 5.], vals
+
+
+# -- process-pool decode (ProcessPoolMap + shm staging) --------------------
+# map fns live at module level so they pickle under every start method
+# (fork ships them for free; spawn/forkserver re-import this module)
+
+
+def _pm_slow_sq(i):
+    time.sleep((i * 37 % 10) / 2500.0)  # deterministic skewed cost
+    return i * i
+
+
+def _pm_ident(i):
+    return i
+
+
+def _pm_boom(i):
+    if i == 7:
+        raise ValueError("decode failed on 7")
+    return i
+
+
+def _pm_decode(i):
+    return {"data": np.full((4, 6), i % 251, np.uint8),
+            "label": np.full((4, 1), i % 10, np.int64)}
+
+
+def test_process_map_preserves_order():
+    """Worker PROCESSES with skewed per-item cost must still emit in
+    input order (the reorder buffer spans the IPC boundary)."""
+    out = list(datapipe.ProcessPoolMap(range(40), _pm_slow_sq,
+                                       num_workers=3))
+    assert out == [i * i for i in range(40)]
+
+
+def test_process_map_unordered_completes():
+    out = list(datapipe.ProcessPoolMap(range(30), _pm_ident,
+                                       num_workers=3, order=False))
+    assert sorted(out) == list(range(30))
+
+
+def test_process_map_worker_error_propagates():
+    """A decode exception in a worker process re-raises in the parent as
+    its original type, carrying the worker traceback in the message."""
+    it = iter(datapipe.ProcessPoolMap(range(20), _pm_boom, num_workers=2))
+    with pytest.raises(ValueError, match="decode failed on 7"):
+        for _ in it:
+            pass
+    it.close()
+
+
+def test_process_map_backpressure_bounds_inflight():
+    """The dispatcher pulls the source in the PARENT, gated by tickets:
+    a slow consumer stalls the pull after at most buffer_size items."""
+    pulled = []
+
+    def src():
+        for i in range(60):
+            pulled.append(i)
+            yield i
+
+    pm = datapipe.ProcessPoolMap(src(), _pm_ident, num_workers=2,
+                                 buffer_size=4)
+    it = iter(pm)
+    consumed = 0
+    max_excess = 0
+    for _ in it:
+        consumed += 1
+        time.sleep(0.003)
+        max_excess = max(max_excess, len(pulled) - consumed)
+        if consumed >= 25:
+            break
+    it.close()
+    assert max_excess <= 5, max_excess
+
+
+def test_process_map_close_mid_stream_reaps_workers():
+    pm = datapipe.ProcessPoolMap(range(200), _pm_ident, num_workers=3)
+    it = iter(pm)
+    next(it)
+    it.close()  # the no_datapipe_thread_leaks fixture asserts the reap
+
+
+def test_process_pipe_fused_shm_end_to_end():
+    """map(processes=True) fused with prefetch_to_device(chunk=K): decoded
+    chunks cross via the shared-memory ring (zero parent-side copies),
+    arrive device-resident in order with the auto-resolved uint8 wire
+    marker, and close() unlinks every segment."""
+    from paddle_tpu.datapipe.transfer import pop_markers
+
+    pipe = (datapipe.DataPipe(range(24))
+            .map(_pm_decode, num_workers=2, processes=True)
+            .prefetch_to_device(place=fluid.CPUPlace(), chunk=4,
+                                capacity=2))
+    chunks = list(pipe)
+    assert len(chunks) == 6
+    for ci, ch in enumerate(chunks):
+        feed, wire, _donate = pop_markers(dict(ch))
+        data = np.asarray(feed["data"])
+        assert data.shape == (4, 4, 6) and data.dtype == np.uint8
+        np.testing.assert_array_equal(
+            data[:, 0, 0], [(ci * 4 + k) % 251 for k in range(4)])
+        assert wire is not None and "data" in wire  # uint8 stays on wire
+    assert pipe.wire_spec is not None and "data" in pipe.wire_spec
+    st = pipe.stats()
+    assert st["map"]["items"] == 24
+    assert st.get("bottleneck_stage") in st  # attribution names a stage
+    assert "occupancy" in st["map"] and "bp_wait_s" in st["map"]
+    pipe.close()
+    assert datapipe.live_segments() == []
+
+
+def test_process_pipe_plain_feeds_batcher():
+    """Unfused process decode (no chunk fusion) feeds the downstream
+    thread stages like ParallelMap — leases (if any) released, order
+    kept."""
+    pipe = (datapipe.DataPipe(range(16))
+            .map(_pm_decode, num_workers=2, processes=True)
+            .batch(2))
+    vals = [b["data"][0, 0, 0] for b in pipe]
+    assert [int(v) for v in vals] == [i % 251 for i in range(0, 16, 2)]
+    pipe.close()
+    assert datapipe.live_segments() == []

@@ -856,3 +856,118 @@ def test_resilience_counters_reach_exposition(tmp_path):
     assert "resilience_retries_total" in text
     assert "checkpoint_write_ms" in text
     assert "checkpoints_saved_total" in text
+
+
+# -- process-pool decode under chaos ------------------------------------
+# decode fns at module level so worker processes can unpickle them under
+# any start method
+
+
+def _proc_ident(i):
+    return i
+
+
+def _proc_sample(i):
+    return {"x": np.full(2, i, np.float32)}
+
+
+def test_chaos_worker_kill_surfaces_datapipe_error():
+    """SIGKILL-ing a decode worker mid-stream (an OOM-killed process,
+    chaos-injected deterministically on a map-item index) must surface a
+    DataPipeError naming the dead pid within one poll interval — not a
+    hang, not a silent truncation."""
+    from paddle_tpu.datapipe import DataPipeError, ProcessPoolMap
+
+    chaos.install(chaos.ChaosMonkey([chaos.Fault("worker_kill", at=5)]))
+    try:
+        t0 = time.time()
+        with pytest.raises(DataPipeError, match="died"):
+            for _ in ProcessPoolMap(range(40), _proc_ident, num_workers=2):
+                pass
+        detect_s = time.time() - t0
+    finally:
+        chaos.uninstall()
+    assert detect_s < 5.0, f"death surfaced only after {detect_s:.1f}s"
+
+
+def test_chaos_worker_kill_restart_replays_lost_items():
+    """Same fault under FLAGS_datapipe_restart_workers=1: the dead
+    worker's in-flight items are re-dispatched to a replacement and the
+    stream completes, in order, with nothing lost or duplicated."""
+    from paddle_tpu.datapipe import ProcessPoolMap
+
+    chaos.install(chaos.ChaosMonkey([chaos.Fault("worker_kill", at=5)]))
+    try:
+        with flags.flag_guard(datapipe_restart_workers=True,
+                              monitor=True):
+            out = list(ProcessPoolMap(range(40), _proc_ident,
+                                      num_workers=2))
+    finally:
+        chaos.uninstall()
+    assert out == list(range(40))
+    snap = monitor.registry().snapshot()
+    assert any(k.startswith("datapipe_worker_restarts_total")
+               for k in snap), snap
+
+
+def _proc_pipe(n=40, batch=4, workers=2):
+    def reader():
+        for i in range(n):
+            yield {"x": np.full(2, i, np.float32)}
+    return (fluid.DataPipe.from_reader(reader)
+            .map(_proc_sample_passthrough, num_workers=workers,
+                 processes=True)
+            .batch(batch))
+
+
+def _proc_sample_passthrough(s):
+    return s
+
+
+def test_datapipe_restore_with_process_pool_stage():
+    """checkpoint_state()/restore_state() across a ProcessPoolMap stage:
+    kill the pipe mid-epoch, rebuild, restore — the resumed stream covers
+    exactly the unconsumed records (bitwise: nothing dropped or
+    replayed)."""
+    pipe = _proc_pipe()
+    it = iter(pipe)
+    for _ in range(2):
+        next(it)
+    state = pipe.checkpoint_state()
+    pipe.close()
+    resumed = _proc_pipe()
+    resumed.restore_state(state)
+    flat = [i for b in resumed for i in b["x"][:, 0].astype(int).tolist()]
+    assert sorted(flat) == list(range(8, 40))
+    resumed.close()
+
+
+def test_datapipe_restore_with_fused_process_stage():
+    """The fused map(processes=True) -> prefetch_to_device(chunk=K) path:
+    one emitted chunk = K source records, so mid-epoch restore lands on
+    the first unconsumed record exactly."""
+    def make():
+        def reader():
+            for i in range(32):
+                yield {"x": np.full(2, i, np.float32)}
+        return (fluid.DataPipe.from_reader(reader)
+                .map(_proc_sample_passthrough, num_workers=2,
+                     processes=True)
+                .prefetch_to_device(place=fluid.CPUPlace(), chunk=2,
+                                    capacity=2))
+
+    pipe = make()
+    it = iter(pipe)
+    for _ in range(3):  # 3 chunks x 2 records consumed
+        next(it)
+    state = pipe.checkpoint_state()
+    pipe.close()
+    assert state["records"] == 6, state
+    resumed = make()
+    resumed.restore_state(state)
+    seen = []
+    for ch in resumed:
+        x = np.asarray(ch["x"])  # [K, 2]
+        seen.extend(x[:, 0].astype(int).tolist())
+    assert sorted(seen) == list(range(6, 32))
+    resumed.close()

@@ -333,3 +333,72 @@ def test_device_chunk_feeder_warns_exactly_once_per_process():
     dep = [i for i in w if issubclass(i.category, DeprecationWarning)
            and "DeviceChunkFeeder" in str(i.message)]
     assert len(dep) == 1, [str(i.message) for i in w]
+
+
+# -- auto wire (FLAGS_wire_compress) ----------------------------------------
+
+
+def test_auto_wire_covers_uint8_feeds_only():
+    from paddle_tpu.datapipe import auto_wire
+
+    spec = auto_wire({"img": np.zeros((4, 4), np.uint8),
+                      "label": np.zeros((4, 1), np.int32),
+                      "__valid__": np.ones(4, bool)})
+    assert spec is not None
+    assert "img" in spec and "label" not in spec
+    assert "__valid__" not in spec  # metadata never rides the wire
+    # already-float feeds have no compressed wire form to pick
+    assert auto_wire({"x": np.zeros(4, np.float32)}) is None
+
+
+def test_auto_wire_flag_gate():
+    from paddle_tpu import flags
+    from paddle_tpu.datapipe import auto_wire
+
+    sample = {"img": np.zeros((4, 4), np.uint8)}
+    assert auto_wire(sample) is not None
+    with flags.flag_guard(wire_compress=False):
+        assert auto_wire(sample) is None  # the opt-out: float on the wire
+
+
+def _u8_decode_sample(i):
+    # module-level: ships to ProcessPoolMap workers under any start method
+    rs = np.random.RandomState(i)
+    return {"x": rs.randint(0, 256, size=(4, 4), dtype=np.uint8)}
+
+
+def test_affine_decode_fusion_matches_float32_reference():
+    """Satellite check for the uint8-by-default wire: the SAME program run
+    (a) through the fused process pipe with WireSpec.uint8_images (uint8
+    on the wire, affine cast+/255 fused into the compiled step) and (b)
+    on host-normalized float32 feeds must agree within float tolerance."""
+    main, startup, y = _scale_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    pipe = (datapipe.DataPipe(range(12))
+            .map(_u8_decode_sample, num_workers=2, processes=True)
+            .prefetch_to_device(place=fluid.CPUPlace(), chunk=3, capacity=2,
+                                wire=WireSpec.uint8_images("x")))
+    got = []
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        exe.run(startup)
+        while True:
+            try:
+                out, = exe.run(main, feed=pipe, fetch_list=[y])
+            except StopIteration:
+                break
+            got.append(np.asarray(out).reshape(-1))
+    pipe.close()
+    assert datapipe.live_segments() == []
+
+    want = []
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        exe.run(startup)
+        for i in range(12):
+            f32 = _u8_decode_sample(i)["x"].astype(np.float32) / 255.0
+            out, = exe.run(main, feed={"x": f32}, fetch_list=[y])
+            want.append(np.asarray(out).reshape(-1))
+    np.testing.assert_allclose(np.concatenate(got),
+                               np.concatenate(want), rtol=1e-6, atol=1e-7)

@@ -252,10 +252,26 @@ for key in ("off_step_ms", "on_step_ms", "off_delta_frac"):
 # FLAGS_trace=0 overhead contract: step time must not move (<=1%, with
 # an absolute floor because sub-ms CPU steps make timer jitter dominate)
 assert tr["off_delta_ok"], tr
+# fused input pipeline smoke: process decode + shm staging must name its
+# bottleneck stage, keep up with the device baseline, and leak nothing
+pl = result.get("pipeline")
+assert pl is not None, result.get("pipeline_error", result)
+assert pl.get("pipeline_bottleneck_stage"), pl
+assert pl["pipeline_frac_of_device"] >= 0.25, pl
+assert pl["pipeline_leaked_shm"] == 0, pl
+assert pl["pipeline_stage_ms"], pl
 print("bench --dry: ok")
 '
 if [ $? -ne 0 ]; then
     echo "GATE: BENCH --dry RED — do not commit" >&2
+    exit 1
+fi
+
+# shm hygiene: no ptpipe_* staging segments may survive the dry bench (a
+# leaked segment accumulates in /dev/shm across runs until reboot)
+if ls /dev/shm/ptpipe_* >/dev/null 2>&1; then
+    echo "GATE: LEAKED SHM SEGMENTS — do not commit" >&2
+    ls /dev/shm/ptpipe_* >&2
     exit 1
 fi
 
