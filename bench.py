@@ -793,6 +793,106 @@ def _mfu_report(fluid, img_s):
     return out
 
 
+def _zero1_ab(fluid):
+    """ZeRO-1 vs all-reduce A/B on the dp mesh (parallel/zero1.py): the
+    same momentum net trained both ways — per-step wall time, analytic
+    collective bytes for both paths, and the per-replica optimizer-state
+    cut. Needs >=2 devices (the caller re-execs onto a virtual CPU mesh
+    when the host has one)."""
+    import jax
+    from paddle_tpu.parallel import zero1 as zero1_mod
+    from paddle_tpu.parallel_executor import BuildStrategy, ParallelExecutor
+
+    n = len(jax.devices())
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(input=x, size=256, act="relu")
+            h = fluid.layers.fc(input=h, size=256, act="relu")
+            p = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=p, label=y))
+            fluid.optimizer.Momentum(
+                learning_rate=0.01, momentum=0.9).minimize(loss)
+            main.random_seed = startup.random_seed = 11
+        return main, startup, loss
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(8 * n, 64).astype(np.float32)
+    ys = rs.randn(8 * n, 1).astype(np.float32)
+
+    out, losses = {"dp": n}, {}
+    for sharded in (False, True):
+        main, startup, loss = build()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            fluid.Executor(fluid.CPUPlace()).run(startup)
+            bs = BuildStrategy()
+            bs.sharded_weight_update = sharded
+            pe = ParallelExecutor(use_cuda=False, main_program=main,
+                                  build_strategy=bs)
+            seq = []
+            for _ in range(5):  # first call compiles; all steps train
+                lv, = pe.run([loss], feed={"x": xs, "y": ys})
+                seq.append(float(np.asarray(lv).reshape(-1)[0]))
+            timed = 10
+            t0 = time.perf_counter()
+            for _ in range(timed):
+                lv, = pe.run([loss], feed={"x": xs, "y": ys})
+            np.asarray(lv)  # fence the last dispatch
+            ms = (time.perf_counter() - t0) * 1000.0 / timed
+        plan = zero1_mod.build_plan(main, n)
+        key = "zero1" if sharded else "all_reduce"
+        losses[key] = seq
+        out[key] = {
+            "step_ms": round(ms, 3),
+            "collective_bytes_per_step": plan.collective_bytes(
+                sharded=sharded),
+            "optimizer_state_bytes_per_replica": plan.optimizer_state_bytes(
+                sharded=sharded),
+        }
+    out["loss_curves"] = losses
+    out["loss_parity_max_abs_diff"] = float(max(
+        abs(a - b) for a, b in zip(losses["zero1"], losses["all_reduce"])))
+    out["optimizer_state_reduction_x"] = round(
+        out["all_reduce"]["optimizer_state_bytes_per_replica"]
+        / max(out["zero1"]["optimizer_state_bytes_per_replica"], 1), 2)
+    out["step_time_ratio"] = round(
+        out["zero1"]["step_ms"] / max(out["all_reduce"]["step_ms"], 1e-9), 3)
+    return out
+
+
+def measure_dry_zero1(fluid):
+    """bench.py --dry zero1 block. With one local device the A/B would be
+    a no-op (zero1 disables below dp=2), so re-exec onto an 8-device
+    virtual CPU mesh — the same trick __graft_entry__.dryrun_multichip
+    uses — and relay the child's JSON."""
+    import jax
+
+    if len(jax.devices()) >= 2:
+        return _zero1_ab(fluid)
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    parts = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    parts.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(parts)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--zero1-dry"],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"zero1 dry subprocess failed (rc={proc.returncode}): "
+            f"{proc.stderr[-500:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def measure_dry(fluid):
     """bench.py --dry: a tiny MLP through the SAME public exe.run(iters=K)
     path with the monitor + HLO cost capture on, emitting the same
@@ -885,6 +985,12 @@ def measure_dry(fluid):
         result["pipeline"] = measure_dry_pipeline(fluid)
     except Exception as e:
         result["pipeline_error"] = f"{type(e).__name__}: {e}"
+    # ZeRO-1 A/B (FLAGS_zero1): loss parity, step time, collective bytes
+    # for both paths, and the per-replica optimizer-state cut
+    try:
+        result["zero1"] = measure_dry_zero1(fluid)
+    except Exception as e:
+        result["zero1_error"] = f"{type(e).__name__}: {e}"
     # serving mode, CI-sized: the same A/B the full --serve run does
     # (unbatched vs Server QPS, percentiles, zero-steady-compile check);
     # runs AFTER the cache snapshot above because it resets the monitor
@@ -900,6 +1006,11 @@ def main():
 
     if "--dry" in sys.argv:
         measure_dry(fluid)
+        return
+
+    if "--zero1-dry" in sys.argv:
+        # child mode of measure_dry_zero1 (8-device virtual CPU mesh)
+        print(json.dumps(_zero1_ab(fluid)))
         return
 
     if "--serve" in sys.argv:

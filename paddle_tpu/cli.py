@@ -18,7 +18,9 @@ Commands:
   checkpoint inspect DIR [--serial N]
                      list a checkpoint directory's serials and their
                      commit status (committed / incomplete / orphaned
-                     .tmp) and show the latest (or chosen) manifest;
+                     .tmp) and show the latest (or chosen) manifest,
+                     including the ZeRO-1 shard layout (param -> shard
+                     owner, shard bytes) when the run had FLAGS_zero1=1;
                      --json emits the report as JSON.
   serve --model-dir DIR [--http PORT | --selftest N]
                      serve a save_inference_model directory with the
@@ -131,6 +133,27 @@ def _cmd_checkpoint(args):
         dp = manifest.get("datapipe")
         if dp:
             print(f"  datapipe: {dp}")
+        zero1 = manifest.get("zero1")
+        if zero1:
+            print(f"  zero1 shard layout ({len(zero1)} sharded params; "
+                  f"checkpoint stores canonical full layout):")
+            for pname in sorted(zero1):
+                ent = zero1[pname]
+                owners = ent.get("owners") or {}
+                own = ", ".join(
+                    f"dp{r}:[{owners[r][0]}:{owners[r][1]})"
+                    for r in sorted(owners, key=int)[:4])
+                if len(owners) > 4:
+                    own += ", ..."
+                print(f"    {pname}: shape={ent.get('shape')} "
+                      f"shards={ent.get('num_shards')}x"
+                      f"{ent.get('shard_numel')} "
+                      f"param_shard={ent.get('param_shard_bytes')}B "
+                      f"accum_shard={ent.get('accum_shard_bytes')}B")
+                print(f"      owners: {own}")
+                accs = ent.get("accums") or []
+                if accs:
+                    print(f"      accums: {', '.join(accs)}")
     elif report.get("format"):
         print(f"legacy io-format checkpoint (no manifest); files: "
               f"{len(report.get('files', []))}")

@@ -1208,6 +1208,26 @@ def _coll_resize(ctx):
     property — left dynamic at build time."""
 
 
+@register_infer_shape("zero1_scatter")
+def _zero1_scatter(ctx):
+    """[parts, ceil(numel/parts)] shard layout of the flattened input."""
+    x = ctx.input_dim("X")
+    parts = ctx.attr("parts")
+    if x is not None and parts and all(d >= 0 for d in x):
+        numel = 1
+        for d in x:
+            numel *= d
+        ctx.set_output_dim("Out", [int(parts), -(-numel // int(parts))])
+
+
+@register_infer_shape("zero1_gather")
+def _zero1_gather(ctx):
+    """Regather restores the exact original parameter shape (attr)."""
+    shape = ctx.attr("shape")
+    if shape:
+        ctx.set_output_dim("Out", [int(d) for d in shape])
+
+
 # -- host / side-effect ops ------------------------------------------------
 def _host_noop(ctx):
     """Side-effect / host ops: no dense output shape semantics at build

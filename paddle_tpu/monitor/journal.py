@@ -22,6 +22,11 @@ Schema (one object per line; optional fields omitted when absent):
   replica_ids   device ids aligned with replica_ms
   skew          {"replicas", "max_ms", "median_ms", "max_over_median",
                  "slowest"}
+  collective_bytes       {"all_reduce": B} or {"reduce_scatter": B,
+                         "all_gather": B} — analytic per-step dp-collective
+                         traffic (parallel_executor; ring model)
+  optimizer_state_bytes  per-replica optimizer accumulator bytes
+  zero1         true when the step ran the sharded weight update
 """
 
 import json
@@ -144,6 +149,19 @@ def summarize_journal(records):
         }
     if slowest:
         out["slowest_replica_counts"] = slowest
+    # ZeRO-1 / collective accounting (parallel_executor extras): the last
+    # record wins — layout is a per-run property, not a per-step average
+    coll = [r for r in records
+            if isinstance(r.get("collective_bytes"), dict)]
+    if coll:
+        last = coll[-1]
+        out["collective_bytes_per_step"] = {
+            k: int(v) for k, v in last["collective_bytes"].items()}
+        if last.get("optimizer_state_bytes") is not None:
+            out["optimizer_state_bytes_per_replica"] = int(
+                last["optimizer_state_bytes"])
+        if last.get("zero1") is not None:
+            out["zero1"] = bool(last.get("zero1"))
     return out
 
 
@@ -174,4 +192,14 @@ def format_summary(summary):
                      key=lambda kv: -kv[1])
         lines.append("slowest replica: " + ", ".join(
             f"{r} x{n}" for r, n in top[:4]))
+    if "collective_bytes_per_step" in summary:
+        cb = summary["collective_bytes_per_step"]
+        mode = "zero1" if summary.get("zero1") else "all-reduce"
+        lines.append(
+            f"dp collectives ({mode}): " + ", ".join(
+                f"{op}={b / 1e6:.3f}MB" for op, b in sorted(cb.items())))
+    if "optimizer_state_bytes_per_replica" in summary:
+        lines.append(
+            f"optimizer state per replica: "
+            f"{summary['optimizer_state_bytes_per_replica'] / 1e6:.3f}MB")
     return "\n".join(lines)

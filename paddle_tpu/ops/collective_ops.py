@@ -77,6 +77,75 @@ def broadcast_op(ctx, ins, attrs):
     return out(Out=jax.lax.psum(masked, axis))
 
 
+def _ambient_mesh_axis(axis):
+    """Size of `axis` in the mesh the surrounding jit is being traced
+    under (ParallelExecutor dispatches inside `with mesh:`), or None when
+    there is no mesh / the axis is absent — the single-device identity
+    case, mirroring _in_mapped_axis for the GSPMD ops below."""
+    try:
+        from jax._src.mesh import thread_resources
+
+        m = thread_resources.env.physical_mesh
+        if m.empty or axis not in m.shape:
+            return None
+        return int(m.shape[axis])
+    except Exception:
+        return None
+
+
+def _constrain(x, spec, axis):
+    """with_sharding_constraint iff a mesh carrying `axis` is ambient.
+    Outside a mesh the constraint would raise; the op then degrades to its
+    single-device semantics (pure reshape), keeping zero1-rewritten
+    programs runnable on a plain Executor with identical numerics."""
+    if _ambient_mesh_axis(axis) is None:
+        return x
+    from jax.sharding import PartitionSpec as P
+
+    return jax.lax.with_sharding_constraint(
+        x, P(axis) if spec == "shard" else P())
+
+
+@register_op("zero1_scatter")
+def zero1_scatter_op(ctx, ins, attrs):
+    """ZeRO-1 shard layout: flatten X, zero-pad to a multiple of `parts`,
+    reshape [parts, shard] and constrain dim 0 onto the dp axis. Under
+    pjit/GSPMD this is the reduce-scatter: the pending gradient cross-
+    replica sum lands only on each replica's shard (XLA's SPMD partitioner
+    turns the all-reduce + slice into reduce-scatter on ICI). The optional
+    `scale` folds GradientScaleStrategy into the collective — one
+    shard-sized multiply AFTER the reduce instead of a full-size per-grad
+    scale on every replica."""
+    x = first(ins, "X")
+    parts = int(attrs["parts"])
+    axis = attrs.get("axis_name", "dp")
+    scale = attrs.get("scale", 1.0)
+    flat = jnp.ravel(x)
+    pad = (-flat.shape[0]) % parts
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = _constrain(flat.reshape(parts, -1), "shard", axis)
+    if scale != 1.0:
+        shard = shard * jnp.asarray(scale, shard.dtype)
+    return out(Out=shard)
+
+
+@register_op("zero1_gather")
+def zero1_gather_op(ctx, ins, attrs):
+    """ZeRO-1 param regather: [parts, shard] -> original shape (drop the
+    zero padding) and constrain replicated — under GSPMD the all-gather of
+    the updated shards. XLA schedules it against whatever consumes the
+    full param next (the following step's forward in a scan, or the async
+    dispatch tail on the per-step path), which is the gather/forward
+    overlap."""
+    x = first(ins, "X")
+    numel = int(attrs["numel"])
+    shape = tuple(attrs.get("shape", (numel,)))
+    axis = attrs.get("axis_name", "dp")
+    full = jnp.ravel(x)[:numel].reshape(shape)
+    return out(Out=_constrain(full, "replicated", axis))
+
+
 @register_op("collective_permute")
 def collective_permute_op(ctx, ins, attrs):
     x = first(ins, "X")

@@ -162,6 +162,32 @@ class Optimizer:
         return optimize_ops, params_grads
 
 
+# -- ZeRO-1 shard metadata ---------------------------------------------------
+# Per optimizer-op type: the param-shaped accumulator slots, as
+# (input_slot, output_slot) pairs. parallel.zero1 uses this to rewrite each
+# update onto a 1/N shard of the parameter: the listed accumulators are
+# stored shard-layout ([num_shards, shard_numel], zero-padded), everything
+# else (LearningRate, Beta*Pow) stays replicated. Only ops listed here are
+# sharded; an op type is eligible when its update is elementwise over the
+# param AND numerically inert on zero-padded lanes (zero grad + zero accum
+# must produce zero accum out and a finite ParamOut — the padded lanes are
+# sliced away before the param write-back, but NaN/Inf there would trip
+# FLAGS_debug_nans). ftrl and proximal_adagrad divide by a zero-initialized
+# accumulator on padded lanes, so they stay on the replicated path.
+ZERO1_SHARDABLE_SLOTS = {
+    "sgd": [],
+    "momentum": [("Velocity", "VelocityOut")],
+    "adam": [("Moment1", "Moment1Out"), ("Moment2", "Moment2Out")],
+    "adagrad": [("Moment", "MomentOut")],
+    "adamax": [("Moment", "MomentOut"), ("InfNorm", "InfNormOut")],
+    "decayed_adagrad": [("Moment", "MomentOut")],
+    "adadelta": [("AvgSquaredGrad", "AvgSquaredGradOut"),
+                 ("AvgSquaredUpdate", "AvgSquaredUpdateOut")],
+    "rmsprop": [("MeanSquare", "MeanSquareOut"), ("Moment", "MomentOut")],
+    "proximal_gd": [],
+}
+
+
 class SGDOptimizer(Optimizer):
     """reference optimizer.py:257"""
 

@@ -6,9 +6,12 @@ Reference mapping (SURVEY.md §2.4):
   gen_nccl_id bootstrap -> distributed.py (jax.distributed over DCN)
   gRPC send/recv        -> rpc.py (TCP variable transport) + ops/rpc_ops.py
   (absent in reference) -> ring_attention.py sequence/context parallelism
+  kReduce strategy      -> zero1.py ZeRO-1 sharded weight update
+                           (FLAGS_zero1 / BuildStrategy.sharded_weight_update)
 """
 
 from . import mesh
+from . import zero1
 from . import distributed
 from . import rpc
 from . import ring
@@ -26,7 +29,7 @@ from .flash import flash_attention
 
 __all__ = [
     "mesh", "distributed", "rpc", "ring", "sharded_embedding", "api",
-    "flash",
+    "flash", "zero1",
     "make_mesh", "data_parallel_mesh", "mesh_scope",
     "ring_attention", "ring_attention_sharded",
     "ring_flash_attention", "ring_flash_attention_sharded",

