@@ -865,6 +865,120 @@ def _zero1_ab(fluid):
     return out
 
 
+def _autoshard_ab(fluid):
+    """Autoshard vs hand-annotated A/B on the dp x mp mesh
+    (parallel/autoshard): an embedding+fc net with seed annotations on
+    just the embedding table and the first fc weight, trained once with
+    BuildStrategy.auto_sharding (propagation derives every other layout)
+    and once on the manual path — loss parity, per-step wall time, and
+    the plan's totality/conflict/reshard stats. Needs >=2 devices."""
+    import jax
+    from paddle_tpu.parallel_executor import BuildStrategy, ParallelExecutor
+
+    n = len(jax.devices())
+    mp = 2 if n % 2 == 0 and n >= 2 else 1
+    dp = n // mp
+    mesh_shape = {"dp": dp, "mp": mp}
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            emb = fluid.layers.embedding(ids, size=[16 * mp, 16])
+            h = fluid.layers.fc(input=emb, size=16 * mp, act="relu")
+            p = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=p, label=y))
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+            main.random_seed = startup.random_seed = 7
+        gb = main.global_block()
+        embw = next(nm for nm, v in gb.vars.items()
+                    if getattr(v, "persistable", False)
+                    and v.shape == (16 * mp, 16))
+        w1 = next(nm for nm, v in gb.vars.items()
+                  if getattr(v, "persistable", False)
+                  and v.shape == (16, 16 * mp))
+        fluid.parallel.set_sharding(gb.var(embw), ("mp", None))
+        fluid.parallel.set_sharding(gb.var(w1), (None, "mp"))
+        return main, startup, loss
+
+    rs = np.random.RandomState(0)
+    ids_np = rs.randint(0, 16 * mp, (8 * n, 1)).astype("int64")
+    ys = rs.randn(8 * n, 1).astype(np.float32)
+
+    out, losses = {"dp": dp, "mp": mp}, {}
+    for auto in (False, True):
+        main, startup, loss = build()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            fluid.Executor(fluid.CPUPlace()).run(startup)
+            bs = BuildStrategy()
+            bs.auto_sharding = auto
+            pe = ParallelExecutor(use_cuda=False, main_program=main,
+                                  build_strategy=bs, mesh_shape=mesh_shape)
+            seq = []
+            for _ in range(5):  # first call compiles; all steps train
+                lv, = pe.run([loss], feed={"ids": ids_np, "y": ys})
+                seq.append(float(np.asarray(lv).reshape(-1)[0]))
+            timed = 10
+            t0 = time.perf_counter()
+            for _ in range(timed):
+                lv, = pe.run([loss], feed={"ids": ids_np, "y": ys})
+            np.asarray(lv)  # fence the last dispatch
+            ms = (time.perf_counter() - t0) * 1000.0 / timed
+            plan = None
+            if auto:
+                plan = (next(iter(pe._autoshard_cache.values()))
+                        if pe._autoshard_cache else None)
+        key = "autoshard" if auto else "manual"
+        losses[key] = seq
+        out[key] = {"step_ms": round(ms, 3)}
+        if plan is not None:
+            out["plan"] = {
+                "total": bool(plan.is_total()),
+                "vars": len(plan.specs),
+                "sharded_vars": len(plan.sharded_names()),
+                "conflicts": len(plan.conflicts),
+                "unresolved": len(plan.unresolved),
+                "reshard_bytes_per_step": int(plan.reshard_bytes_per_step()),
+                "digest": plan.digest(),
+            }
+    out["loss_curves"] = losses
+    out["loss_parity_max_abs_diff"] = float(max(
+        abs(a - b) for a, b in zip(losses["autoshard"], losses["manual"])))
+    out["step_time_ratio"] = round(
+        out["autoshard"]["step_ms"] / max(out["manual"]["step_ms"], 1e-9), 3)
+    return out
+
+
+def measure_dry_autoshard(fluid):
+    """bench.py --dry autoshard block. Propagation needs a real multi-axis
+    mesh, so with one local device re-exec onto an 8-device virtual CPU
+    mesh (same trick as measure_dry_zero1) and relay the child's JSON."""
+    import jax
+
+    if len(jax.devices()) >= 2:
+        return _autoshard_ab(fluid)
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    parts = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    parts.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(parts)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--autoshard-dry"],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"autoshard dry subprocess failed (rc={proc.returncode}): "
+            f"{proc.stderr[-500:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def measure_dry_zero1(fluid):
     """bench.py --dry zero1 block. With one local device the A/B would be
     a no-op (zero1 disables below dp=2), so re-exec onto an 8-device
@@ -991,6 +1105,12 @@ def measure_dry(fluid):
         result["zero1"] = measure_dry_zero1(fluid)
     except Exception as e:
         result["zero1_error"] = f"{type(e).__name__}: {e}"
+    # autoshard A/B (FLAGS_autoshard): seed-only propagation vs the
+    # hand-annotated path — loss parity plus the plan totality stats
+    try:
+        result["autoshard"] = measure_dry_autoshard(fluid)
+    except Exception as e:
+        result["autoshard_error"] = f"{type(e).__name__}: {e}"
     # serving mode, CI-sized: the same A/B the full --serve run does
     # (unbatched vs Server QPS, percentiles, zero-steady-compile check);
     # runs AFTER the cache snapshot above because it resets the monitor
@@ -1011,6 +1131,11 @@ def main():
     if "--zero1-dry" in sys.argv:
         # child mode of measure_dry_zero1 (8-device virtual CPU mesh)
         print(json.dumps(_zero1_ab(fluid)))
+        return
+
+    if "--autoshard-dry" in sys.argv:
+        # child mode of measure_dry_autoshard (8-device virtual CPU mesh)
+        print(json.dumps(_autoshard_ab(fluid)))
         return
 
     if "--serve" in sys.argv:

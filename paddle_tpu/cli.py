@@ -154,10 +154,103 @@ def _cmd_checkpoint(args):
                 accs = ent.get("accums") or []
                 if accs:
                     print(f"      accums: {', '.join(accs)}")
+        ashard = manifest.get("autoshard")
+        if ashard:
+            mesh = ashard.get("mesh_axes") or {}
+            mesh_s = "×".join(f"{k}={v}" for k, v in mesh.items())
+            params = ashard.get("params") or {}
+            print(f"  autoshard plan digest={ashard.get('digest')} "
+                  f"mesh[{mesh_s}] layout={ashard.get('layout', 'full')} "
+                  f"({len(params)} sharded params; checkpoint stores "
+                  f"canonical full layout):")
+            for pname in sorted(params):
+                spec = ", ".join(str(a) for a in params[pname])
+                print(f"    {pname}: ({spec})")
     elif report.get("format"):
         print(f"legacy io-format checkpoint (no manifest); files: "
               f"{len(report.get('files', []))}")
     return 0
+
+
+def _shard_demo_program():
+    """Small embedding+fc net with mp seeds on the embedding table and the
+    first fc weight — the same shape of model the autoshard dryrun and
+    bench A/B use."""
+    import paddle_tpu as fluid
+
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(ids, size=[32, 16])
+        h = fluid.layers.fc(emb, 32, act="relu")
+        p = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    gb = main.global_block()
+    embw = next(n for n, v in gb.vars.items()
+                if getattr(v, "persistable", False) and v.shape == (32, 16))
+    w1 = next(n for n, v in gb.vars.items()
+              if getattr(v, "persistable", False) and v.shape == (16, 32))
+    fluid.parallel.set_sharding(gb.var(embw), ("mp", None))
+    fluid.parallel.set_sharding(gb.var(w1), (None, "mp"))
+    return main
+
+
+def _cmd_shard(args):
+    import json
+
+    from .parallel import autoshard
+
+    mesh_axes = {}
+    for part in (args.mesh or "").split(","):
+        if not part.strip():
+            continue
+        k, _, v = part.partition("=")
+        try:
+            mesh_axes[k.strip()] = int(v)
+        except ValueError:
+            print(f"bad --mesh entry {part!r} (want name=size)",
+                  file=sys.stderr)
+            return 1
+    if not mesh_axes:
+        print("empty --mesh", file=sys.stderr)
+        return 1
+    seeds = {}
+    for s in args.seed or []:
+        name, _, spec_s = s.partition("=")
+        seeds[name.strip()] = tuple(
+            None if e.strip() in ("", "None", "none", "-") else e.strip()
+            for e in spec_s.split(","))
+    if args.selftest:
+        program = _shard_demo_program()
+    elif args.model_dir:
+        from .core.framework import Program
+
+        with open(os.path.join(args.model_dir, "__model__")) as f:
+            payload = json.load(f)
+        program = Program.from_dict(payload["program"])
+    else:
+        print("shard plan needs --model-dir or --selftest", file=sys.stderr)
+        return 1
+    try:
+        plan = autoshard.build_plan(program, mesh_axes,
+                                    batch_axis=args.batch_axis,
+                                    extra_seeds=seeds or None)
+    except (TypeError, ValueError) as e:
+        print(f"shard plan error: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(plan.describe(), indent=2))
+    else:
+        print(plan.render(verbose=not args.quiet))
+    ok = plan.is_total() and not plan.unresolved
+    if args.selftest:
+        ok = ok and len(plan.sharded_names()) > 0
+        # stderr so --json stdout stays machine-parseable
+        print(f"shard plan selftest: {'OK' if ok else 'FAILED'}",
+              file=sys.stderr if args.json else sys.stdout)
+    return 0 if ok else 2
 
 
 def _cmd_serve(args):
@@ -499,6 +592,30 @@ def main(argv=None):
     ci.add_argument("--json", action="store_true",
                     help="emit the report as JSON")
 
+    sh = sub.add_parser("shard", help="autoshard: GSPMD-style sharding "
+                                      "plans over a program")
+    shsub = sh.add_subparsers(dest="shard_action", required=True)
+    shp = shsub.add_parser("plan", help="propagate seeds and render the "
+                                        "total ShardingPlan with per-edge "
+                                        "estimated reshard bytes")
+    shp.add_argument("--model-dir", default=None,
+                     help="save_inference_model directory to plan")
+    shp.add_argument("--selftest", action="store_true",
+                     help="build a small embedding+fc demo net, plan it, "
+                          "and verify the plan is total")
+    shp.add_argument("--mesh", default="dp=4,mp=2",
+                     help="mesh axes as name=size pairs (plan construction "
+                          "is analytic — no devices needed)")
+    shp.add_argument("--seed", action="append", metavar="NAME=SPEC",
+                     help="extra seed annotation, e.g. fc_0.w_0=None,mp "
+                          "(repeatable; entries are axis names or None)")
+    shp.add_argument("--batch-axis", default="dp",
+                     help="mesh axis seeded onto data vars' dim 0")
+    shp.add_argument("--json", action="store_true",
+                     help="emit plan.describe() as JSON")
+    shp.add_argument("--quiet", action="store_true",
+                     help="summary and edges only, no per-var table")
+
     s = sub.add_parser("serve", help="serve a saved inference model with "
                                      "the batching engine")
     s.add_argument("--model-dir", required=True,
@@ -617,6 +734,8 @@ def main(argv=None):
             return _cmd_monitor(args)
         if args.command == "checkpoint":
             return _cmd_checkpoint(args)
+        if args.command == "shard":
+            return _cmd_shard(args)
         if args.command == "serve":
             return _cmd_serve(args)
         if args.command == "trace":

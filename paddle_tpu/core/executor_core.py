@@ -59,7 +59,7 @@ class OpContext:
     execution (control flow), test-mode flag."""
 
     def __init__(self, rng=None, is_test=False, eager=False, scope=None, feed=None,
-                 fetch_sink=None, place=None):
+                 fetch_sink=None, place=None, constraints=None):
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.is_test = is_test
         self.eager = eager
@@ -67,6 +67,10 @@ class OpContext:
         self.feed = feed or {}
         self.fetch_sink = fetch_sink if fetch_sink is not None else []
         self.place = place
+        # {var name: jax.sharding.NamedSharding} — autoshard plan boundaries
+        # lowered as with_sharding_constraint at the producing op's output
+        # (trace mode only; eager/host ops never see device layouts)
+        self.constraints = constraints or {}
 
     def next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -257,13 +261,42 @@ def _run_one_op(op, env, ctx):
                 if n and i < len(vals) and vals[i] is not None:
                     named.append((n, vals[i]))
         check_values_finite(named, context=f" after op {op.type!r}")
+    cons = ctx.constraints
     for slot, names in op.outputs.items():
         vals = outs.get(slot, [])
         for i, name in enumerate(names):
             if not name:
                 continue
             if i < len(vals) and vals[i] is not None:
-                env[name] = vals[i]
+                v = vals[i]
+                if cons and not ctx.eager and name in cons:
+                    v = _apply_sharding_constraint(v, cons[name])
+                env[name] = v
+
+
+def _apply_sharding_constraint(v, named_sharding):
+    """with_sharding_constraint, skipped for values it can't apply to:
+    non-array containers (SeqTensor/SelectedRows), rank shorter than the
+    spec, and dims not divisible by their axis sizes (the plan is built
+    from static shapes; runtime bucket shapes are authoritative here)."""
+    if not hasattr(v, "shape") or not hasattr(v, "dtype") \
+            or isinstance(v, SeqTensor):
+        return v
+    shape = v.shape
+    spec = named_sharding.spec
+    if len(spec) > len(shape):
+        return v
+    mesh = named_sharding.mesh
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    for d, ax in enumerate(spec):
+        if ax is None:
+            continue
+        n = 1
+        for a in (ax if isinstance(ax, tuple) else (ax,)):
+            n *= sizes.get(a, 1)
+        if n and shape[d] % n:
+            return v
+    return jax.lax.with_sharding_constraint(v, named_sharding)
 
 
 # ---------------------------------------------------------------------------
@@ -324,12 +357,17 @@ def dead_code_eliminate(ops, needed_names):
     return live
 
 
-def build_step_fn(program, fetch_names, state_out_names, is_test=False):
+def build_step_fn(program, fetch_names, state_out_names, is_test=False,
+                  constraints=None):
     """Build the pure step function for a program's global block.
 
     signature: step(mut_state, const_state, feeds, rng) -> (fetches, new_mut)
     mut_state (vars the block writes) is donated by the jit wrapper so
     parameter/optimizer-state buffers are updated in place on device.
+
+    constraints: optional {var name: NamedSharding} applied as
+    with_sharding_constraint where each var is produced (autoshard plan
+    lowering — see paddle_tpu.parallel.autoshard).
     """
     ops = dead_code_eliminate(
         program.global_block().ops, list(fetch_names) + list(state_out_names)
@@ -340,7 +378,7 @@ def build_step_fn(program, fetch_names, state_out_names, is_test=False):
         env.update(const_state)
         env.update(mut_state)
         env.update(feeds)
-        ctx = OpContext(rng=rng, is_test=is_test)
+        ctx = OpContext(rng=rng, is_test=is_test, constraints=constraints)
         run_ops(ops, env, ctx)
         fetches = [env_get(env, n) for n in fetch_names]
         new_mut = {n: env[n] for n in state_out_names if n in env}

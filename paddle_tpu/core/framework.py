@@ -182,6 +182,12 @@ class Parameter(Variable):
         super().__init__(block, shape=shape, dtype=dtype, **kwargs)
 
 
+# Called (newest first) with each Parameter right after Block.create_parameter
+# registers it — parallel.sharding_scope uses this to seed-annotate params
+# built inside a layer block without threading state through every layer.
+_param_creation_hooks = []
+
+
 class Operator:
     """An op node: type + {slot: [var names]} inputs/outputs + attrs
 
@@ -301,6 +307,8 @@ class Block:
         global_block = self.program.global_block()
         param = Parameter(global_block, shape=shape, dtype=dtype, **kwargs)
         global_block.vars[param.name] = param
+        for hook in reversed(list(_param_creation_hooks)):
+            hook(param)
         return param
 
     def var(self, name):

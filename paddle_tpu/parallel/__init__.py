@@ -8,10 +8,13 @@ Reference mapping (SURVEY.md §2.4):
   (absent in reference) -> ring_attention.py sequence/context parallelism
   kReduce strategy      -> zero1.py ZeRO-1 sharded weight update
                            (FLAGS_zero1 / BuildStrategy.sharded_weight_update)
+  (absent in reference) -> autoshard/ GSPMD-style sharding propagation
+                           (FLAGS_autoshard / BuildStrategy.auto_sharding)
 """
 
 from . import mesh
 from . import zero1
+from . import autoshard
 from . import distributed
 from . import rpc
 from . import ring
@@ -24,15 +27,15 @@ from .ring import (ring_attention, ring_attention_sharded,
                    ring_flash_attention,
                    ring_flash_attention_sharded)
 from .sharded_embedding import shard_table, sharded_embedding_lookup
-from .api import set_sharding, get_sharding
+from .api import set_sharding, get_sharding, sharding_scope
 from .flash import flash_attention
 
 __all__ = [
     "mesh", "distributed", "rpc", "ring", "sharded_embedding", "api",
-    "flash", "zero1",
+    "flash", "zero1", "autoshard",
     "make_mesh", "data_parallel_mesh", "mesh_scope",
     "ring_attention", "ring_attention_sharded",
     "ring_flash_attention", "ring_flash_attention_sharded",
     "shard_table", "sharded_embedding_lookup",
-    "set_sharding", "get_sharding", "flash_attention",
+    "set_sharding", "get_sharding", "sharding_scope", "flash_attention",
 ]

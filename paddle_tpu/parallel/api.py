@@ -9,25 +9,36 @@ insert the tensor-parallel collectives (SURVEY §2.4 TP row).
 
     w = fluid.layers.create_parameter(...)
     fluid.parallel.set_sharding(w, (None, "mp"))   # shard columns over mp
+    fluid.parallel.set_sharding(w2, "mp")          # bare axis: shard dim 0
+    fluid.parallel.set_sharding(w3, PartitionSpec(None, "mp"))  # jax spec
     pe = fluid.ParallelExecutor(loss_name=..., mesh_shape={"dp": 2, "mp": 4})
+
+With autoshard (docs/autoshard.md) a few seeds are enough — the plan
+propagates them to every activation, grad and optimizer slot. To seed all
+params built inside a block, use `sharding_scope`:
+
+    with fluid.parallel.sharding_scope((None, "mp")):
+        h = fluid.layers.fc(x, 256)   # weight gets (None, "mp")
 """
 
-from ..core.framework import Variable
+import contextlib
 
-__all__ = ["set_sharding", "get_sharding"]
+from ..core import framework
+from ..core.framework import Variable
+from .autoshard.spec import normalize_spec
+
+__all__ = ["set_sharding", "get_sharding", "sharding_scope"]
 
 
 def set_sharding(var, spec):
     """Declare `var`'s mesh placement. spec: one entry per tensor dim —
     a mesh axis name (str) to shard that dim, or None to replicate it.
-    A spec shorter than the rank leaves trailing dims replicated."""
+    A spec shorter than the rank leaves trailing dims replicated. Also
+    accepts a bare axis-name string (shards dim 0) and a
+    jax.sharding.PartitionSpec; both normalize to the tuple form."""
     if not isinstance(var, Variable):
         raise TypeError(f"set_sharding expects a Variable, got {type(var)}")
-    spec = tuple(spec)
-    for e in spec:
-        if e is not None and not isinstance(e, str):
-            raise TypeError(f"spec entries must be mesh-axis names or None, "
-                            f"got {e!r}")
+    spec = normalize_spec(spec)
     if var.shape is not None and len(spec) > len(var.shape):
         raise ValueError(
             f"spec {spec} longer than {var.name}'s rank {len(var.shape)}")
@@ -37,3 +48,27 @@ def set_sharding(var, spec):
 
 def get_sharding(var):
     return getattr(var, "sharding", None)
+
+
+@contextlib.contextmanager
+def sharding_scope(spec):
+    """Seed-annotate every parameter created inside the block with `spec`
+    (truncated to each param's rank; params whose truncated spec names no
+    mesh axis — e.g. 1-D biases under (None, "mp") — are left alone, as
+    are params already annotated explicitly). Scopes nest; the innermost
+    one wins."""
+    spec = normalize_spec(spec)
+
+    def hook(param):
+        if getattr(param, "sharding", None) is not None:
+            return
+        rank = len(param.shape) if param.shape is not None else 0
+        trimmed = spec[:rank]
+        if any(e is not None for e in trimmed):
+            param.sharding = tuple(trimmed)
+
+    framework._param_creation_hooks.append(hook)
+    try:
+        yield
+    finally:
+        framework._param_creation_hooks.remove(hook)

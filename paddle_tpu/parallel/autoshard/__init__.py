@@ -1,0 +1,31 @@
+"""paddle_tpu.parallel.autoshard — GSPMD-style sharding propagation.
+
+Seed a handful of params with `parallel.set_sharding` (or wrap layer
+construction in `parallel.sharding_scope`), and autoshard produces a
+*total* ShardingPlan assigning every Program variable — params,
+activations, grads, optimizer slots — a PartitionSpec over the mesh.
+ParallelExecutor lowers the plan as `with_sharding_constraint` at op
+outputs inside the compiled step fn when `FLAGS_autoshard` /
+`BuildStrategy.auto_sharding` is on. See docs/autoshard.md.
+
+    fluid.parallel.set_sharding(emb_w, ("mp", None))
+    fluid.parallel.set_sharding(fc_w, (None, "mp"))
+    bs = fluid.BuildStrategy(); bs.auto_sharding = True
+    pe = fluid.ParallelExecutor(loss_name=loss.name,
+                                mesh_shape={"dp": 4, "mp": 2},
+                                build_strategy=bs)
+"""
+
+from .spec import normalize_spec, canon, pad_spec, spec_str
+from .plan import ShardingPlan, transition_bytes
+from .rules import register_rule, rule_for, registered_ops
+from .propagate import (build_plan, validate_seeds, register_plan,
+                        active_plan, reset_registry, manifest_section)
+
+__all__ = [
+    "normalize_spec", "canon", "pad_spec", "spec_str",
+    "ShardingPlan", "transition_bytes",
+    "register_rule", "rule_for", "registered_ops",
+    "build_plan", "validate_seeds",
+    "register_plan", "active_plan", "reset_registry", "manifest_section",
+]

@@ -43,6 +43,7 @@ from .core.lod_tensor import LoDTensor
 from .core.registry import SeqTensor
 from .core.scope import global_scope
 from .executor import as_numpy, _apply_debug_nans
+from .parallel import autoshard as _autoshard
 from .parallel import zero1 as _zero1
 from .resilience import chaos as _chaos
 from .resilience import watchdog as _watchdog
@@ -80,6 +81,10 @@ class BuildStrategy:
         # ZeRO-1 sharded weight update (arXiv 2004.13336): None defers to
         # FLAGS_zero1; True/False overrides the flag for this executor
         self.sharded_weight_update = None
+        # GSPMD-style autoshard (parallel.autoshard): propagate set_sharding
+        # seeds over the whole program and lower the plan as
+        # with_sharding_constraint. None defers to FLAGS_autoshard.
+        self.auto_sharding = None
         self.debug_graphviz_path = ""
 
 
@@ -131,6 +136,8 @@ class ParallelExecutor:
         # program identity + mutation counter; strong refs keep id() stable
         # for the compile cache
         self._rewrite_cache = {}
+        # autoshard ShardingPlans, keyed on (program identity, mutation)
+        self._autoshard_cache = {}
         self._step = 0
         self.num_trainers = num_trainers
         self.trainer_id = trainer_id
@@ -180,8 +187,22 @@ class ParallelExecutor:
         self._rewrite_cache[key] = (run_program, plan)
         return run_program, plan
 
-    def _state_sharding(self, name, value, program=None):
-        """User set_sharding() rules win; else replicated by default, with
+    def _autoshard_plan(self, program):
+        """Total ShardingPlan for the RESOLVED program (zero1-rewritten when
+        that pass is on, so its shard-layout accumulator annotations become
+        locked seeds). Cached per (program identity, mutation, mesh)."""
+        mesh_axes = {str(k): int(v) for k, v in self._mesh.shape.items()}
+        key = (id(program), program._mutation,
+               tuple(sorted(mesh_axes.items())))
+        plan = self._autoshard_cache.get(key)
+        if plan is None:
+            plan = _autoshard.build_plan(program, mesh_axes)
+            self._autoshard_cache[key] = plan
+        return plan
+
+    def _state_sharding(self, name, value, program=None, plan=None):
+        """User set_sharding() rules win; then the autoshard plan's spec
+        when a plan is active; else replicated by default, with
         BuildStrategy.Reduce sharding optimizer accumulators (non-Parameter
         persistables) on dim 0 when divisible (ZeRO-1 analogue)."""
         program = program if program is not None else self._program
@@ -206,6 +227,17 @@ class ParallelExecutor:
                         f"{name} dim {d} ({value.shape[d]}) not divisible "
                         f"by mesh axis {ax!r} ({self._mesh.shape[ax]})")
             return NamedSharding(self._mesh, P(*spec))
+        if plan is not None:
+            pspec = plan.spec_of(name)
+            if pspec and hasattr(value, "shape") \
+                    and len(pspec) <= len(value.shape):
+                # plan specs are derived from static shapes; skip any that
+                # don't divide the runtime shape rather than erroring
+                ok = all(
+                    ax is None or value.shape[d] % self._mesh.shape[ax] == 0
+                    for d, ax in enumerate(pspec))
+                if ok:
+                    return NamedSharding(self._mesh, P(*pspec))
         n = len(self._devices)
         if (
             self._build_strategy.reduce_strategy == BuildStrategy.ReduceStrategy.Reduce
@@ -290,6 +322,21 @@ class ParallelExecutor:
         # placement) runs against the resolved program — the zero1 rewrite
         # when sharding is on, else the original (plus One-scale ops)
         program, zplan = self._prepare_program(program, use_zero1, gss, dp_n)
+        use_autoshard = bs.auto_sharding
+        if use_autoshard is None:
+            use_autoshard = bool(flags.get("autoshard"))
+        use_autoshard = bool(use_autoshard) and len(self._devices) > 1
+        aplan = None
+        if use_autoshard:
+            # built on the RESOLVED program so zero1's accumulator layouts
+            # compose as locked seeds; raises the clear compile-time error
+            # for bad seeds (unknown axis / non-divisible static dim)
+            aplan = self._autoshard_plan(program)
+            _autoshard.register_plan(aplan)
+        else:
+            # same compile-time seed validation even when the pass is off —
+            # a bad annotation should never surface mid-placement
+            _autoshard.validate_seeds(program, dict(self._mesh.shape))
         if use_zero1 and zplan.entries:
             # accumulators live permanently in [dp_n, shard] layout; a
             # full-layout scope (startup init, or a checkpoint restore)
@@ -321,6 +368,37 @@ class ParallelExecutor:
                 k: int(v) for k, v in cb.items()}
             mon.extra["optimizer_state_bytes"] = int(osb)
             mon.extra["zero1"] = bool(use_zero1)
+        if mon is not None and aplan is not None:
+            reg = monitor.registry()
+            reg.gauge(
+                "autoshard_reshard_bytes_per_step",
+                help="analytic per-step reshard traffic forced by plan "
+                     "conflicts and locked-seed boundaries",
+            ).set(float(aplan.reshard_bytes_per_step()))
+            reg.gauge(
+                "autoshard_plan_vars",
+                help="variables covered by the active autoshard plan",
+            ).set(float(len(aplan.specs)))
+            reg.gauge(
+                "autoshard_plan_sharded_vars",
+                help="plan variables with at least one sharded dim",
+            ).set(float(len(aplan.sharded_names())))
+            reg.gauge(
+                "autoshard_conflicts_resolved",
+                help="propagation conflicts arbitrated by the cost model",
+            ).set(float(len(aplan.conflicts)))
+            reg.gauge(
+                "autoshard_unresolved_vars",
+                help="plan variables with no resolvable layout (should be 0)",
+            ).set(float(len(aplan.unresolved)))
+            if mon.extra is None:
+                mon.extra = {}
+            mon.extra["autoshard"] = {
+                "digest": aplan.digest(),
+                "sharded_vars": len(aplan.sharded_names()),
+                "conflicts": len(aplan.conflicts),
+                "reshard_bytes": int(aplan.reshard_bytes_per_step()),
+            }
         t_enc = time.perf_counter() if mon is not None else None
         feed_vals = {}
         if iters is not None:
@@ -357,6 +435,7 @@ class ParallelExecutor:
             ("wire", wire.fingerprint() if wire is not None else None),
             ("donate_feeds", donate_feeds),
             ("zero1", use_zero1, gss, dp_n),
+            ("autoshard", aplan.digest() if aplan is not None else None),
         )
         entry = self._compile_cache.get(cache_key)
         fp = monitor.fingerprint_of(cache_key) if mon is not None else None
@@ -366,7 +445,14 @@ class ParallelExecutor:
         was_miss = entry is None
         if entry is None:
             tb = time.perf_counter()
-            step = executor_core.build_step_fn(program, fetch_names, state_out_names)
+            constraints = None
+            if aplan is not None:
+                constraints = {
+                    n: NamedSharding(self._mesh, P(*s))
+                    for n, s in aplan.boundary_specs().items()}
+            step = executor_core.build_step_fn(
+                program, fetch_names, state_out_names,
+                constraints=constraints)
             if wire is not None:
                 # decode in the PER-STEP fn (before the scan wrapper), so
                 # each iteration widens only its own [batch, ...] slice
@@ -422,21 +508,24 @@ class ParallelExecutor:
                 v = executor_core.feed_to_tracevalue(v)
             var = program.global_block().vars.get(n)
             annotated = getattr(var, "sharding", None) is not None
+            planned = aplan is not None and bool(aplan.spec_of(n))
             cur = getattr(v, "sharding", None)
             on_mesh = isinstance(cur, NamedSharding) and cur.mesh == self._mesh
-            if annotated:
-                # the rule must win over whatever placement startup left
-                # behind — but once the array already carries the desired
-                # NamedSharding (every step after the first), re-placing
-                # would all-gather the shards to host each run
-                desired = self._state_sharding(n, v, program=program)
+            if annotated or planned:
+                # the rule (user seed or plan spec) must win over whatever
+                # placement startup left behind — but once the array already
+                # carries the desired NamedSharding (every step after the
+                # first), re-placing would all-gather the shards to host
+                desired = self._state_sharding(n, v, program=program,
+                                               plan=aplan)
                 if cur != desired:
                     v = place(v, desired)
             elif not on_mesh or not getattr(v, "committed", True):
                 # startup leaves single-device committed arrays; a jit over
                 # the mesh auto-transfers those in-process but REJECTS them
                 # when the mesh spans processes — re-place onto this mesh
-                v = place(v, self._state_sharding(n, v, program=program))
+                v = place(v, self._state_sharding(n, v, program=program,
+                                                  plan=aplan))
             (mut_state if n in out_set else const_state)[n] = v
 
         base_key = jax.random.PRNGKey(program.random_seed)

@@ -246,6 +246,16 @@ class CheckpointManager:
         }
         if zinfo:
             manifest["zero1"] = zinfo
+        # Autoshard (parallel.autoshard): mp-sharded params are gathered to
+        # host by _host_value, so the snapshot is already the canonical full
+        # layout; the active plan's digest + per-param specs ride the
+        # manifest (mirroring the zero1 contract) so `checkpoint inspect`
+        # shows the layout and restores stay layout-independent.
+        from ..parallel import autoshard as _autoshard
+
+        ainfo = _autoshard.manifest_section(snap)
+        if ainfo:
+            manifest["autoshard"] = ainfo
         if pipe is not None and hasattr(pipe, "checkpoint_state"):
             manifest["datapipe"] = pipe.checkpoint_state()
         if monitor.enabled():

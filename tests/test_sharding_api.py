@@ -105,3 +105,67 @@ def test_bad_divisibility_raises():
                 pe.run(fetch_list=[loss],
                        feed={"x": rng.randn(8, 16).astype(np.float32),
                              "label": np.zeros((8, 1), np.int64)})
+
+
+def test_set_sharding_accepts_bare_axis_and_partition_spec():
+    """Satellite forms: a bare axis-name string shards dim 0, and a
+    jax.sharding.PartitionSpec is accepted verbatim."""
+    from jax.sharding import PartitionSpec as P
+
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.fc(input=x, size=2,
+                        param_attr=fluid.ParamAttr(name="W"))
+        w = fluid.default_main_program().global_block().var("W")
+        set_sharding(w, "mp")
+        assert get_sharding(w) == ("mp",)
+        set_sharding(w, P(None, "mp"))
+        assert get_sharding(w) == (None, "mp")
+        set_sharding(w, P("dp"))
+        assert get_sharding(w) == ("dp",)
+        with pytest.raises(TypeError):
+            set_sharding(w, P(("dp", "mp"), None))  # multi-axis dim
+
+
+def test_sharding_scope_annotates_created_params():
+    from paddle_tpu.parallel import sharding_scope
+
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        with sharding_scope((None, "mp")):
+            h = fluid.layers.fc(input=x, size=32, act="relu",
+                                param_attr=fluid.ParamAttr(name="w1"))
+            fluid.layers.fc(input=h, size=8,
+                            param_attr=fluid.ParamAttr(name="w2"))
+        p = fluid.layers.fc(input=h, size=1,
+                            param_attr=fluid.ParamAttr(name="w3"))
+        gb = fluid.default_main_program().global_block()
+        assert get_sharding(gb.var("w1")) == (None, "mp")
+        assert get_sharding(gb.var("w2")) == (None, "mp")
+        # the 1-D biases get the spec TRUNCATED to their rank -> all-None
+        # -> skipped (stay unannotated), and params outside the scope too
+        biases = [n for n, v in gb.vars.items()
+                  if getattr(v, "persistable", False) and len(v.shape) == 1]
+        assert biases
+        for n in biases:
+            assert get_sharding(gb.var(n)) is None, n
+        assert get_sharding(gb.var("w3")) is None
+
+
+def test_sharding_scope_inner_wins_and_explicit_seed_survives():
+    from paddle_tpu.parallel import sharding_scope
+
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        with sharding_scope((None, "mp")):
+            with sharding_scope(("mp", None)):
+                h = fluid.layers.fc(input=x, size=32, bias_attr=False,
+                                    param_attr=fluid.ParamAttr(name="wi"))
+            fluid.layers.fc(input=h, size=32, bias_attr=False,
+                            param_attr=fluid.ParamAttr(name="wo"))
+        gb = fluid.default_main_program().global_block()
+        assert get_sharding(gb.var("wi")) == ("mp", None)
+        assert get_sharding(gb.var("wo")) == (None, "mp")
+        # explicit set_sharding still overrides afterwards
+        set_sharding(gb.var("wi"), (None, "mp"))
+        assert get_sharding(gb.var("wi")) == (None, "mp")

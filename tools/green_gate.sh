@@ -273,6 +273,15 @@ zc = z["zero1"]["collective_bytes_per_step"]
 assert zc.get("reduce_scatter") and zc.get("all_gather"), z
 assert zc["reduce_scatter"] < \
     z["all_reduce"]["collective_bytes_per_step"]["all_reduce"], z
+# autoshard A/B: with seeds on just the embedding table and one fc weight,
+# propagation must produce a TOTAL plan (every var assigned, zero
+# unresolved) whose loss curve matches the hand-annotated path <= 1e-4
+a = result.get("autoshard")
+assert a is not None, result.get("autoshard_error", result)
+assert a["loss_parity_max_abs_diff"] <= 1e-4, a
+assert a["plan"]["total"], a
+assert a["plan"]["unresolved"] == 0, a
+assert a["plan"]["sharded_vars"] > 0, a
 print("bench --dry: ok")
 '
 if [ $? -ne 0 ]; then
@@ -287,6 +296,24 @@ fi
 python -c "import __graft_entry__ as g; g.dryrun_zero1(8)"
 if [ $? -ne 0 ]; then
     echo "GATE: ZERO1 MULTICHIP DRYRUN RED — do not commit" >&2
+    exit 1
+fi
+
+# autoshard multichip dryrun: on the dp=4 x mp=2 virtual CPU mesh, seed
+# annotations on the embedding + fc weights alone must propagate to a
+# TOTAL plan (zero unresolved) and match the hand-annotated loss curve
+# <= 1e-4 through the real ParallelExecutor, with reshard/plan gauges live
+python -c "import __graft_entry__ as g; g.dryrun_autoshard(8)"
+if [ $? -ne 0 ]; then
+    echo "GATE: AUTOSHARD MULTICHIP DRYRUN RED — do not commit" >&2
+    exit 1
+fi
+
+# shard plan CLI: the self-contained planner demo must resolve a total
+# plan and exit 0 (exercises the seed-validation + render path end to end)
+JAX_PLATFORMS=cpu python -m paddle_tpu shard plan --selftest --quiet
+if [ $? -ne 0 ]; then
+    echo "GATE: SHARD PLAN CLI RED — do not commit" >&2
     exit 1
 fi
 
