@@ -98,6 +98,7 @@ from .pipeline import DeviceChunkFeeder
 from . import datapipe
 from .datapipe import DataPipe, AsyncDeviceFeeder
 from . import monitor
+from . import analysis
 from . import resilience
 from .resilience import ResilienceConfig, ResilientRunner
 from . import dataset

@@ -253,6 +253,90 @@ def _cmd_shard(args):
     return 0 if ok else 2
 
 
+def _check_demo_program():
+    """Small MLP training program for `check --selftest`."""
+    import paddle_tpu as fluid
+
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        p = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, ["x", "y"], [loss.name]
+
+
+def _cmd_check(args):
+    import json
+
+    from . import analysis
+
+    mesh_axes = None
+    if args.mesh:
+        mesh_axes = {}
+        for part in args.mesh.split(","):
+            if not part.strip():
+                continue
+            k, _, v = part.partition("=")
+            try:
+                mesh_axes[k.strip()] = int(v)
+            except ValueError:
+                print(f"bad --mesh entry {part!r} (want name=size)",
+                      file=sys.stderr)
+                return 2
+
+    if args.selftest:
+        # 1) a well-formed training program must verify clean ...
+        prog, feeds, fetches = _check_demo_program()
+        clean = analysis.verify(prog, level="full", feed_names=feeds,
+                                fetch_names=fetches, mesh_axes=mesh_axes,
+                                context="check --selftest")
+        # 2) ... and the SAME program with an op knocked out must not:
+        # drop the first fc's matmul, leaving its output undefined
+        broken = prog.clone()
+        ops = broken.global_block().ops
+        del ops[next(i for i, op in enumerate(ops) if op.type == "mul")]
+        bad = analysis.verify(broken, level="full", feed_names=feeds,
+                              fetch_names=fetches, mesh_axes=mesh_axes,
+                              context="check --selftest (broken)")
+        ok = clean.ok and not bad.ok and "PTA001" in bad.codes()
+        if args.json:
+            print(json.dumps({"ok": ok, "clean": clean.to_dict(),
+                              "broken": bad.to_dict()}, indent=2))
+        else:
+            print(clean.render(verbose=not args.quiet))
+            print("--- intentionally broken program (must flag PTA001) ---")
+            print(bad.render(verbose=not args.quiet))
+            print(f"check selftest: {'OK' if ok else 'FAILED'}")
+        return 0 if ok else 1
+
+    if not args.model_dir:
+        print("check needs --model-dir or --selftest", file=sys.stderr)
+        return 2
+    from .core.framework import Program
+
+    model_path = os.path.join(args.model_dir, "__model__")
+    try:
+        with open(model_path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot load {model_path}: {e}", file=sys.stderr)
+        return 2
+    program = Program.from_dict(payload["program"])
+    report = analysis.verify(
+        program, level=args.level,
+        feed_names=payload.get("feed_var_names"),
+        fetch_names=payload.get("fetch_var_names"),
+        mesh_axes=mesh_axes, context=f"check {args.model_dir}")
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(report.render(verbose=not args.quiet))
+    return report.rc
+
+
 def _cmd_serve(args):
     import json
 
@@ -616,6 +700,26 @@ def main(argv=None):
     shp.add_argument("--quiet", action="store_true",
                      help="summary and edges only, no per-var table")
 
+    ck = sub.add_parser("check", help="static program verification: graph/"
+                                      "safety/sharding checks and the "
+                                      "peak-HBM estimate (docs/analysis.md)")
+    ck.add_argument("--model-dir", default=None,
+                    help="save_inference_model directory to verify")
+    ck.add_argument("--level", default="full", choices=["basic", "full"],
+                    help="basic: structure + shape contracts; full: adds "
+                         "safety/sharding checks and the HBM table")
+    ck.add_argument("--mesh", default=None, metavar="NAME=SIZE,...",
+                    help="mesh axes for the sharding checks and per-replica "
+                         "HBM accounting, e.g. dp=4,mp=2")
+    ck.add_argument("--selftest", action="store_true",
+                    help="verify a clean demo program AND an intentionally "
+                         "broken clone (must flag PTA001); rc 0 when both "
+                         "behave")
+    ck.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    ck.add_argument("--quiet", action="store_true",
+                    help="show errors only, not warnings")
+
     s = sub.add_parser("serve", help="serve a saved inference model with "
                                      "the batching engine")
     s.add_argument("--model-dir", required=True,
@@ -736,6 +840,8 @@ def main(argv=None):
             return _cmd_checkpoint(args)
         if args.command == "shard":
             return _cmd_shard(args)
+        if args.command == "check":
+            return _cmd_check(args)
         if args.command == "serve":
             return _cmd_serve(args)
         if args.command == "trace":

@@ -1427,3 +1427,111 @@ def _nce_grad(ctx):
                     ("Bias@GRAD", b)):
         if d is not None:
             ctx.set_output_dim(slot, d)
+
+
+# ---------------------------------------------------------------------------
+# High-traffic hand-written grad kernels. The VJP rule all of them share:
+# d(input slot S) has S's shape — the grad op's output slots are the forward
+# input slots suffixed @GRAD, and its inputs carry the forward slots plus
+# the incoming output grads (registry.make_vjp_kernel's convention, which
+# the hand-written kernels follow). Family-specific checks ride on top.
+# Surfaced as the PTA005 worklist by analysis.verifier.check_contracts.
+# ---------------------------------------------------------------------------
+def _mirror_grad(ctx):
+    for slot in list(ctx.op.outputs):
+        if not slot.endswith("@GRAD"):
+            continue
+        d = ctx.input_dim(slot[: -len("@GRAD")])
+        if d is not None:
+            ctx.set_output_dim(slot, d)
+
+
+register_infer_shape("mul_grad", "square_error_cost_grad",
+                     "mean_grad")(_mirror_grad)
+
+
+@register_infer_shape(
+    "relu_grad", "tanh_grad", "sigmoid_grad", "sqrt_grad", "abs_grad",
+    "square_grad", "exp_grad", "log_grad", "floor_grad", "ceil_grad",
+    "round_grad", "reciprocal_grad", "softplus_grad", "softsign_grad",
+    "leaky_relu_grad", "relu6_grad", "elu_grad", "hard_sigmoid_grad",
+    "swish_grad", "softmax_grad", "scale_grad", "cos_grad", "sin_grad",
+    "gelu_grad", "pow_grad")
+def _unary_grad(ctx):
+    # elementwise: dX is X-shaped and the incoming grad must agree with X
+    x = ctx.input_dim("X")
+    g = ctx.input_dim("Out@GRAD")
+    if x is not None and g is not None:
+        ctx.enforce(_shapes_match(x, g),
+                    f"Out@GRAD{g} must match X{x} (elementwise grad)")
+    d = x if x is not None else g
+    if d is not None:
+        ctx.set_output_dim("X@GRAD", d)
+
+
+@register_infer_shape(
+    "elementwise_add_grad", "elementwise_sub_grad", "elementwise_mul_grad",
+    "elementwise_div_grad", "elementwise_max_grad", "elementwise_min_grad",
+    "elementwise_pow_grad")
+def _elementwise_grad(ctx):
+    # Out has X's shape (Y broadcasts against X), so the incoming grad
+    # must match X; dX/dY mirror their forward operands (dY is the
+    # broadcast-reduced grad)
+    x = ctx.input_dim("X")
+    g = ctx.input_dim("Out@GRAD")
+    if x is not None and g is not None:
+        ctx.enforce(_shapes_match(x, g),
+                    f"Out@GRAD{g} must match X{x} (Out is X-shaped)")
+    _mirror_grad(ctx)
+
+
+@register_infer_shape("cross_entropy_grad")
+def _cross_entropy_grad(ctx):
+    x = ctx.input_dim("X")
+    lab = ctx.input_dim("Label")
+    if x is not None:
+        ctx.enforce(len(x) >= 2,
+                    f"X must be [batch, classes], got {x}")
+        if lab is not None:
+            ctx.enforce(_dim_match(x[0], lab[0]),
+                        f"batch mismatch: X{x} vs Label{lab}")
+        ctx.set_output_dim("X@GRAD", x)
+
+
+@register_infer_shape("conv2d_grad", "depthwise_conv2d_grad")
+def _conv2d_grad(ctx):
+    x = ctx.input_dim("Input")
+    w = ctx.input_dim("Filter")
+    g = ctx.input_dim("Output@GRAD")
+    if w is not None:
+        ctx.enforce(len(w) == 4, f"Filter must be [M, C/g, kh, kw], got {w}")
+        if g is not None:
+            nhwc = ctx.attr("data_format", "NCHW") == "NHWC"
+            ctx.enforce(len(g) == 4, f"Output@GRAD must be 4-D, got {g}")
+            ctx.enforce(_dim_match(g[3 if nhwc else 1], w[0]),
+                        f"Output@GRAD channels {g} != num_filters {w[0]}")
+        ctx.set_output_dim("Filter@GRAD", w)
+    if x is not None:
+        ctx.enforce(len(x) == 4, f"Input must be 4-D, got {x}")
+        ctx.set_output_dim("Input@GRAD", x)
+
+
+@register_infer_shape("pool2d_grad", "max_pool2d_with_index_grad")
+def _pool2d_grad(ctx):
+    x = ctx.input_dim("X")
+    g = ctx.input_dim("Out@GRAD")
+    if x is not None:
+        ctx.enforce(len(x) == 4, f"X must be 4-D, got {x}")
+        if g is not None:
+            ctx.enforce(len(g) == 4 and _dim_match(x[0], g[0]),
+                        f"Out@GRAD{g} must be 4-D with X{x}'s batch")
+        ctx.set_output_dim("X@GRAD", x)
+
+
+@register_infer_shape(
+    "reduce_sum_grad", "reduce_mean_grad", "reduce_max_grad",
+    "reduce_min_grad", "reduce_prod_grad")
+def _reduce_grad(ctx):
+    x = ctx.input_dim("X")
+    if x is not None:
+        ctx.set_output_dim("X@GRAD", x)

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from . import amp
+from . import analysis
 from . import flags
 from . import monitor
 from .core import executor_core, registry
@@ -468,6 +469,14 @@ class Executor:
         build_s = 0.0
         was_miss = entry is None
         if entry is None:
+            # FLAGS_verify: static checks ride the compile-cache MISS path
+            # only (memoized per program+mutation+config), so the enabled
+            # flag's steady-state cost is this one dict lookup
+            analysis.ensure_verified(
+                program, feed_names=list(feed_vals),
+                fetch_names=list(fetch_names),
+                donate_state=not flags.get("debug_nans"),
+                context="executor")
             tb = time.perf_counter()
             step = executor_core.build_step_fn(program, fetch_names, state_out_names)
             if wire is not None:
@@ -605,6 +614,11 @@ class Executor:
         build_s = 0.0
         was_miss = entry is None
         if entry is None:
+            analysis.ensure_verified(
+                program, feed_names=list(feed_vals),
+                fetch_names=list(fetch_names),
+                donate_state=not flags.get("debug_nans"),
+                context="executor")
             tb = time.perf_counter()
             step = executor_core.build_step_fn(
                 program, fetch_names, state_out_names)

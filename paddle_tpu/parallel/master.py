@@ -301,6 +301,11 @@ class MasterService:
                              daemon=True).start()
 
     def _serve_conn(self, conn):
+        # leases granted over THIS connection and not yet reported back.
+        # A trainer that dies mid-task takes its socket with it: requeue
+        # its outstanding leases on disconnect instead of leaking them
+        # until the lease timeout stalls the whole pass on one dead peer.
+        held = {}  # task_id -> epoch as granted here
         try:
             while True:
                 msg = _rpc._recv_msg(conn)
@@ -311,12 +316,15 @@ class MasterService:
                         reply = ("ok", None)
                     elif op == "get_task":
                         t = self.get_task(args[0])
+                        held[t.id] = t.epoch
                         reply = ("ok", (t.id, t.epoch, t.chunks))
                     elif op == "task_finished":
                         self.task_finished(args[0])
+                        held.pop(args[0], None)
                         reply = ("ok", None)
                     elif op == "task_failed":
                         self.task_failed(args[0], args[1])
+                        held.pop(args[0], None)
                         reply = ("ok", None)
                     elif op == "register":
                         self.register(*args)
@@ -340,6 +348,17 @@ class MasterService:
         finally:
             with self._mu:
                 self._conns.discard(conn)
+                # reclaim this connection's outstanding leases now; the
+                # epoch guard in _process_failed_locked drops entries a
+                # reconnected client already re-leased under a new epoch
+                requeued = False
+                for tid, epoch in held.items():
+                    if tid in self.pending:
+                        self._process_failed_locked(tid, epoch)
+                        requeued = True
+                if requeued:
+                    self._maybe_rollover_locked()
+                    self._snapshot_locked()
             try:
                 conn.close()
             except OSError:

@@ -35,6 +35,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import amp
+from . import analysis
 from . import flags
 from . import monitor
 from .core import executor_core
@@ -444,6 +445,17 @@ class ParallelExecutor:
         build_s = 0.0
         was_miss = entry is None
         if entry is None:
+            # FLAGS_verify on the MISS path only, with the mesh and the
+            # zero1/autoshard plans in scope so the `full` level can run
+            # the sharding checks and the per-replica peak-HBM estimate
+            analysis.ensure_verified(
+                program, feed_names=list(feed_vals),
+                fetch_names=list(fetch_names),
+                mesh_axes=dict(self._mesh.shape),
+                zplan=zplan if use_zero1 and zplan.entries else None,
+                aplan=aplan,
+                donate_state=not flags.get("debug_nans"),
+                context="parallel_executor")
             tb = time.perf_counter()
             constraints = None
             if aplan is not None:
@@ -565,6 +577,18 @@ class ParallelExecutor:
                 mon.phase("dispatch", call_s)
         for n, v in new_mut.items():
             scope.set_var(n, v)
+        if was_miss and flags.get("verify") == "full":
+            # measured counterpart of the analysis_peak_hbm gauge: bytes
+            # actually resident on one device for this step's state (the
+            # estimate is gated against this within 2x in the tests)
+            live = analysis.measured_live_bytes(
+                list(new_mut.values()) + list(const_state.values())
+                + list(fetches))
+            monitor.registry().gauge(
+                "hbm_live_bytes_per_replica",
+                help="measured per-device resident bytes of the step "
+                     "state + fetches",
+            ).set(float(live))
         outs = [
             executor_core.value_to_lod_tensor(f) if isinstance(f, SeqTensor) else f
             for f in fetches

@@ -1,0 +1,442 @@
+"""Static ProgramDesc verification (paddle_tpu.analysis).
+
+Covers the PTA code catalog end to end: clean book-style programs must
+verify with zero errors, and targeted mutations — deleted producer op,
+reordered collective, collective under control flow, non-divisible shard,
+read-after-donate, write-after-read — must each surface their stable code.
+Plus the liveness peak-HBM estimate (gated against measured live bytes on
+the 8-virtual-device mesh), the FLAGS_verify executor wiring, and the
+`check` CLI.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import analysis, flags
+from paddle_tpu.analysis import ProgramVerificationError
+from paddle_tpu.core.framework import (OpRole, OP_ROLE_ATTR_NAME, Program,
+                                       program_guard)
+from paddle_tpu.parallel import zero1
+from paddle_tpu.parallel import autoshard
+
+
+# ---------------------------------------------------------------------------
+# program builders
+# ---------------------------------------------------------------------------
+def _mlp():
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        p = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, ["x", "y"], [loss.name]
+
+
+def _conv():
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                dtype="float32")
+        lab = fluid.layers.data(name="lab", shape=[1], dtype="int64")
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                act="tanh")
+        p = fluid.layers.pool2d(c, pool_size=2, pool_type="max",
+                                pool_stride=2)
+        f = fluid.layers.fc(p, size=10, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(f, lab))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, ["img", "lab"], [loss.name]
+
+
+def _embedding():
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(ids, size=[32, 16])
+        h = fluid.layers.fc(emb, size=32, act="relu")
+        p = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, ["ids", "y"], [loss.name]
+
+
+def _while_loop():
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype="int64",
+                                           value=5)
+        acc = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                         value=0.0)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            new_acc = fluid.layers.elementwise_add(
+                acc, fluid.layers.fill_constant(
+                    shape=[1], dtype="float32", value=2.0))
+            fluid.layers.assign(new_acc, acc)
+            fluid.layers.increment(x=i, value=1, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+    return main, [], [acc.name]
+
+
+def _zero1_program(parts=8):
+    main, feeds, fetches = _mlp()
+    rewritten, plan = zero1.apply(main, parts)
+    return rewritten, plan, feeds, fetches
+
+
+# ---------------------------------------------------------------------------
+# clean-program sweep: book-style programs verify with zero errors
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("builder", [_mlp, _conv, _embedding, _while_loop],
+                         ids=["mlp", "conv", "embedding", "while"])
+def test_clean_programs_verify_with_zero_errors(builder):
+    main, feeds, fetches = builder()
+    r = analysis.verify(main, level="full", feed_names=feeds,
+                        fetch_names=fetches)
+    assert r.ok and r.rc == 0, [str(d) for d in r.errors()]
+    assert not r.warnings(), [str(d) for d in r.warnings()]
+    assert r.summary["n_ops"] > 0
+
+
+def test_zero1_rewritten_program_verifies_clean():
+    rewritten, plan, feeds, fetches = _zero1_program()
+    r = analysis.verify(rewritten, level="full", feed_names=feeds,
+                        fetch_names=fetches, mesh_axes={"dp": 8},
+                        zplan=plan)
+    assert r.ok, [str(d) for d in r.errors()]
+
+
+def test_verify_rejects_unknown_level():
+    main, feeds, fetches = _mlp()
+    with pytest.raises(ValueError, match="level"):
+        analysis.verify(main, level="paranoid")
+
+
+# ---------------------------------------------------------------------------
+# mutation tests: each corruption class surfaces its stable PTA code
+# ---------------------------------------------------------------------------
+def test_mutation_deleted_producer_is_pta001():
+    main, feeds, fetches = _mlp()
+    ops = main.global_block().ops
+    del ops[next(i for i, op in enumerate(ops) if op.type == "mul")]
+    r = analysis.verify(main, level="basic", feed_names=feeds,
+                        fetch_names=fetches)
+    assert "PTA001" in r.codes() and r.rc == 1
+    d = next(d for d in r.errors() if d.code == "PTA001")
+    # location quality: op index, op type and the var name are all present
+    assert d.op_idx is not None and d.op_type and d.var
+
+
+def test_mutation_duplicate_output_is_pta002():
+    main, feeds, fetches = _mlp()
+    gb = main.global_block()
+    op = next(op for op in gb.ops if op.type == "mul")
+    op.outputs["Out"] = [op.outputs["Out"][0], op.outputs["Out"][0]]
+    r = analysis.verify(main, level="basic", feed_names=feeds,
+                        fetch_names=fetches)
+    assert "PTA002" in r.codes()
+
+
+def test_mutation_bad_weight_shape_is_pta004():
+    main, feeds, fetches = _mlp()
+    gb = main.global_block()
+    # corrupt a LEAF shape (a parameter: nothing re-infers it), breaking
+    # the mul contract's inner-dim check on replay
+    w = next(n for n, v in gb.vars.items() if v.shape == (16, 1))
+    gb.vars[w].shape = (999, 1)
+    r = analysis.verify(main, level="basic", feed_names=feeds,
+                        fetch_names=fetches)
+    assert "PTA004" in r.codes() and r.rc == 1
+
+
+def test_mutation_reordered_collective_is_pta012():
+    rewritten, plan, feeds, fetches = _zero1_program()
+    ops = rewritten.global_block().ops
+    gi = next(i for i, op in enumerate(ops) if op.type == "zero1_gather")
+    # issue the gather BEFORE the shard update it must consume
+    ops.insert(0, ops.pop(gi))
+    r = analysis.verify(rewritten, level="full", feed_names=feeds,
+                        fetch_names=fetches, mesh_axes={"dp": 8},
+                        zplan=plan)
+    assert "PTA012" in r.codes() and r.rc == 1
+
+
+def test_mutation_collective_under_control_flow_is_pta013():
+    main, feeds, fetches = _while_loop()
+    gb = main.global_block()
+    wh = next(op for op in gb.ops if op.type == "while")
+    sub = next(v for v in wh.attrs.values()
+               if v.__class__.__name__ == "Block")
+    name = next(n for op in sub.ops for n in op.input_arg_names() if n)
+    sub.append_op(type="all_reduce", inputs={"X": [name]},
+                  outputs={"Out": [name]}, attrs={})
+    r = analysis.verify(main, level="full", feed_names=feeds,
+                        fetch_names=fetches)
+    assert "PTA013" in r.codes() and r.rc == 1
+
+
+def test_mutation_nondivisible_shard_is_pta021():
+    main, feeds, fetches = _mlp()
+    gb = main.global_block()
+    w = next(n for n, v in gb.vars.items() if v.shape == (8, 16))
+    fluid.parallel.set_sharding(gb.var(w), ("dp", None))
+    r = analysis.verify(main, level="full", feed_names=feeds,
+                        fetch_names=fetches, mesh_axes={"dp": 3})
+    assert "PTA021" in r.codes() and r.rc == 1
+
+
+def test_mutation_unknown_mesh_axis_is_pta020():
+    main, feeds, fetches = _mlp()
+    gb = main.global_block()
+    w = next(n for n, v in gb.vars.items() if v.shape == (8, 16))
+    fluid.parallel.set_sharding(gb.var(w), ("mp", None))
+    r = analysis.verify(main, level="full", feed_names=feeds,
+                        fetch_names=fetches, mesh_axes={"dp": 8})
+    assert "PTA020" in r.codes()
+
+
+def test_mutation_read_after_donate_is_pta010():
+    main, feeds, fetches = _mlp()
+    gb = main.global_block()
+    w = next(n for n, v in gb.vars.items()
+             if getattr(v, "persistable", False) and v.shape == (8, 16))
+    out = gb.create_var(name="late_read", dtype="float32", shape=(8, 16))
+    gb.append_op(type="scale", inputs={"X": [w]}, outputs={"Out": [out]},
+                 attrs={"scale": 1.0,
+                        OP_ROLE_ATTR_NAME: int(OpRole.Forward)})
+    r = analysis.verify(main, level="full", feed_names=feeds,
+                        fetch_names=fetches + ["late_read"])
+    assert "PTA010" in r.codes() and r.rc == 1
+
+
+def test_mutation_write_after_read_is_pta011():
+    main, feeds, fetches = _mlp()
+    gb = main.global_block()
+    # clobber relu's input between the forward consume and relu_grad's read
+    g = next(i for i, op in enumerate(gb.ops) if op.type == "relu_grad")
+    name = gb.ops[g].inputs["X"][0]
+    boundary = next(i for i, op in enumerate(gb.ops)
+                    if int(op.attrs.get(OP_ROLE_ATTR_NAME, 0))
+                    & int(OpRole.Backward))
+    gb.append_op(type="scale", inputs={"X": [name]},
+                 outputs={"Out": [name]},
+                 attrs={"scale": 2.0,
+                        OP_ROLE_ATTR_NAME: int(OpRole.Forward)})
+    gb.ops.insert(boundary, gb.ops.pop())
+    r = analysis.verify(main, level="full", feed_names=feeds,
+                        fetch_names=fetches)
+    assert "PTA011" in r.codes() and r.rc == 1
+
+
+# ---------------------------------------------------------------------------
+# plan validation
+# ---------------------------------------------------------------------------
+def test_zero1_plan_geometry_tamper_is_pta021():
+    rewritten, plan, feeds, fetches = _zero1_program()
+    plan.entries[0].shard += 1  # shard * parts no longer covers padded
+    r = analysis.verify(rewritten, level="full", feed_names=feeds,
+                        fetch_names=fetches, mesh_axes={"dp": 8},
+                        zplan=plan)
+    assert "PTA021" in r.codes() and r.rc == 1
+
+
+def test_autoshard_plan_validates_and_audits_edges():
+    main, feeds, fetches = _embedding()
+    gb = main.global_block()
+    embw = next(n for n, v in gb.vars.items()
+                if getattr(v, "persistable", False) and v.shape == (32, 16))
+    fluid.parallel.set_sharding(gb.var(embw), ("mp", None))
+    plan = autoshard.build_plan(main, {"dp": 4, "mp": 2})
+    r = analysis.verify(main, level="full", feed_names=feeds,
+                        fetch_names=fetches, mesh_axes={"dp": 4, "mp": 2},
+                        aplan=plan)
+    assert r.ok, [str(d) for d in r.errors()]
+    assert "PTA023" not in r.codes()
+    if plan.reshard_edges:  # tampered edge bytes must fail the audit
+        plan.reshard_edges[0]["bytes"] = \
+            int(plan.reshard_edges[0].get("bytes", 0)) * 10 + 12345
+        r2 = analysis.verify(main, level="full", feed_names=feeds,
+                             fetch_names=fetches,
+                             mesh_axes={"dp": 4, "mp": 2}, aplan=plan)
+        assert "PTA023" in r2.codes()
+
+
+# ---------------------------------------------------------------------------
+# peak-HBM estimate
+# ---------------------------------------------------------------------------
+def test_hbm_estimate_accounts_params_exactly():
+    main, feeds, fetches = _mlp()
+    est = analysis.estimate_peak_hbm(main, fetch_names=fetches)
+    # fc weights/biases: 8*16 + 16 + 16*1 + 1 floats
+    want = (8 * 16 + 16 + 16 * 1 + 1) * 4
+    assert est["param_bytes"] == want
+    assert est["peak_bytes_per_replica"] >= want
+    assert est["peak_transient_bytes"] > 0
+    assert est["peak_op_type"] is not None
+
+
+def test_hbm_estimate_divides_sharded_vars():
+    main, feeds, fetches = _mlp()
+    gb = main.global_block()
+    w = next(n for n, v in gb.vars.items() if v.shape == (8, 16))
+    base = analysis.estimate_peak_hbm(main, mesh_axes={"dp": 8},
+                                      fetch_names=fetches)
+    fluid.parallel.set_sharding(gb.var(w), ("dp", None))
+    sharded = analysis.estimate_peak_hbm(main, mesh_axes={"dp": 8},
+                                         fetch_names=fetches)
+    # the 8x16 weight now costs 1/8th per replica
+    assert base["param_bytes"] - sharded["param_bytes"] \
+        == (8 * 16) * 4 - (8 * 16) * 4 // 8
+
+
+def test_hbm_estimate_within_2x_of_measured_on_mesh():
+    """Acceptance gate: FLAGS_verify=full sets both gauges and the static
+    estimate lands within 2x of the measured live bytes per replica."""
+    from paddle_tpu import monitor
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[32], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=64, act="relu")
+        p = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    scope = fluid.Scope()
+    analysis.reset()
+    with fluid.scope_guard(scope), flags.flag_guard(verify="full"):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                    main_program=main)
+        xs = np.random.RandomState(0).randn(64, 32).astype("float32")
+        ys = (xs[:, :1] * 0.5).astype("float32")
+        pe.run([loss], feed={"x": xs, "y": ys})
+    snap = monitor.registry().snapshot()
+    est = next(v for k, v in snap.items()
+               if k.startswith("analysis_peak_hbm_bytes_per_replica"))
+    measured = snap["hbm_live_bytes_per_replica"]
+    assert measured > 0 and est > 0
+    assert est <= 2.0 * measured and measured <= 2.0 * est, \
+        (est, measured)
+
+
+# ---------------------------------------------------------------------------
+# executor wiring (FLAGS_verify)
+# ---------------------------------------------------------------------------
+def test_flags_verify_full_clean_run_and_broken_raise():
+    scope = fluid.Scope()
+    xs = np.random.RandomState(0).randn(4, 8).astype("float32")
+    ys = np.zeros((4, 1), "float32")
+    analysis.reset()
+    with fluid.scope_guard(scope), flags.flag_guard(verify="full"):
+        exe = fluid.Executor(fluid.CPUPlace())
+        main2, startup2 = Program(), Program()
+        with fluid.unique_name.guard(), program_guard(main2, startup2):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(x, size=16, act="relu")
+            p = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(p, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe.run(startup2)
+        out, = exe.run(main2, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        assert np.isfinite(np.asarray(out)).all()
+        # a corrupted clone must refuse to compile, naming the code
+        broken = main2.clone()
+        ops = broken.global_block().ops
+        del ops[next(i for i, op in enumerate(ops) if op.type == "mul")]
+        broken._mutation += 1
+        with pytest.raises(ProgramVerificationError) as ei:
+            exe.run(broken, feed={"x": xs, "y": ys},
+                    fetch_list=[loss.name])
+        assert "PTA001" in ei.value.report.codes()
+        assert "PTA001" in str(ei.value)
+
+
+def test_ensure_verified_memoizes_per_program_config():
+    main, feeds, fetches = _mlp()
+    analysis.reset()
+    with flags.flag_guard(verify="basic"):
+        r1 = analysis.ensure_verified(main, feed_names=feeds,
+                                      fetch_names=fetches)
+        r2 = analysis.ensure_verified(main, feed_names=feeds,
+                                      fetch_names=fetches)
+        assert r1 is r2  # memo hit: the same Report object comes back
+        main._mutation += 1
+        r3 = analysis.ensure_verified(main, feed_names=feeds,
+                                      fetch_names=fetches)
+        assert r3 is not r1
+    assert analysis.ensure_verified(main) is None  # level off -> no-op
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def test_cli_check_selftest_ok(capsys):
+    from paddle_tpu.cli import main as cli_main
+    rc = cli_main(["check", "--selftest"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "check selftest: OK" in out and "PTA001" in out
+
+
+def test_cli_check_model_dir_and_json(tmp_path, capsys):
+    from paddle_tpu.cli import main as cli_main
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        p = fluid.layers.fc(h, size=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [p], exe, main_program=main)
+    rc = cli_main(["check", "--model-dir", d, "--json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rc == 0 and rep["ok"] and rep["n_errors"] == 0
+    assert rep["hbm"]["peak_bytes_per_replica"] > 0
+    # corrupt the saved program: drop an op, expect rc 1 + PTA001
+    path = os.path.join(d, "__model__")
+    with open(path) as f:
+        payload = json.load(f)
+    blk = payload["program"]["blocks"][0]
+    blk["ops"] = [op for op in blk["ops"] if op["type"] != "mul"]
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    rc = cli_main(["check", "--model-dir", d, "--json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert "PTA001" in {dd["code"] for dd in rep["diagnostics"]}
+
+
+def test_cli_check_usage_errors(capsys):
+    from paddle_tpu.cli import main as cli_main
+    assert cli_main(["check"]) == 2
+    assert cli_main(["check", "--model-dir", "/nonexistent-dir-xyz"]) == 2
+    assert cli_main(["check", "--selftest", "--mesh", "dp=oops"]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# catalog stability
+# ---------------------------------------------------------------------------
+def test_catalog_codes_are_stable():
+    """Append-only contract: these codes and their meanings are shipped;
+    a rename or renumber here breaks green_gate and downstream tooling."""
+    want = {"PTA001", "PTA002", "PTA003", "PTA004", "PTA005", "PTA006",
+            "PTA007", "PTA008", "PTA010", "PTA011", "PTA012", "PTA013",
+            "PTA020", "PTA021", "PTA022", "PTA023"}
+    assert want <= set(analysis.CATALOG)
+    with pytest.raises(ValueError, match="unknown diagnostic code"):
+        analysis.Diagnostic("PTA999", "nope")

@@ -191,6 +191,61 @@ def test_killed_trainer_mid_epoch_pass_completes():
     svc.stop()
 
 
+def test_dead_trainer_connection_requeues_leases_immediately():
+    """Regression: a trainer that dies takes its socket with it; the
+    master must reclaim that connection's outstanding leases on
+    disconnect instead of leaking them until the lease timeout (30 s
+    here, so only the disconnect path can requeue in time)."""
+    svc = _svc(chunks_per_task=1, lease_timeout=30.0, failure_max=3)
+    port = svc.serve()
+    try:
+        a = MasterClient(f"127.0.0.1:{port}")
+        a.set_dataset(["a", "b", "c"])
+        t = a.get_task(0)
+        assert svc.counts()["pending"] == 1
+        a.close()  # dies holding the lease
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and svc.counts()["pending"]:
+            time.sleep(0.02)
+        c = svc.counts()
+        assert c["pending"] == 0 and c["todo"] == 3, c
+        # the reclaimed task is immediately re-leasable by a survivor
+        b = MasterClient(f"127.0.0.1:{port}")
+        ids = set()
+        for _ in range(3):
+            t2 = b.get_task(0)
+            ids.add(t2.id)
+            b.task_finished(t2.id)
+        assert t.id in ids
+        b.close()
+    finally:
+        svc.stop()
+
+
+def test_disconnect_reclaim_ignores_releases_and_stale_epochs():
+    """Reported-back leases are not double-requeued on disconnect, and a
+    lease re-granted to another trainer under a newer epoch survives the
+    first trainer's death (the epoch guard)."""
+    svc = _svc(chunks_per_task=1, lease_timeout=1.0, failure_max=5)
+    port = svc.serve()
+    try:
+        a = MasterClient(f"127.0.0.1:{port}")
+        a.set_dataset(["only"])
+        ta = a.get_task(0)
+        time.sleep(1.6)  # A's lease expires; the task requeues (timeout)
+        b = MasterClient(f"127.0.0.1:{port}")
+        tb = b.get_task(0)  # re-leased under a new epoch
+        assert tb.id == ta.id and tb.epoch > ta.epoch
+        a.close()  # A's stale held lease must not clobber B's
+        time.sleep(0.3)
+        assert svc.counts()["pending"] == 1, svc.counts()
+        b.task_finished(tb.id)
+        assert svc.counts()["cur_pass"] == 1
+        b.close()
+    finally:
+        svc.stop()
+
+
 def test_pserver_checkpoint_roundtrip():
     from paddle_tpu.ops.rpc_ops import (save_pserver_checkpoint,
                                         load_pserver_checkpoint)

@@ -185,3 +185,80 @@ def test_reorder_lod_tensor_by_rank_grad_contract():
                     inputs={"Out@GRAD": ["g"], "RankTable": ["rt"]},
                     outputs={"X@GRAD": ["dx"]}, attrs={})
     assert tuple(block.vars["dx"].shape) == (6, 4)
+
+# ---------------------------------------------------------------------------
+# Hand-written grad-kernel contracts (analysis PTA005 worklist): every grad
+# output mirrors its forward slot's shape, and the incoming output grad must
+# agree with the forward activation where the rule is elementwise.
+# ---------------------------------------------------------------------------
+def _grad_block(**vars_):
+    prog = fluid.Program()
+    block = prog.global_block()
+    for name, shape in vars_.items():
+        block.create_var(name=name, shape=shape, dtype="float32")
+    return block
+
+
+def test_mul_grad_mirrors_forward_operands():
+    block = _grad_block(x=(6, 8), w=(8, 4), g=(6, 4), dx=None, dw=None)
+    block.append_op(type="mul_grad",
+                    inputs={"X": ["x"], "Y": ["w"], "Out@GRAD": ["g"]},
+                    outputs={"X@GRAD": ["dx"], "Y@GRAD": ["dw"]}, attrs={})
+    assert tuple(block.vars["dx"].shape) == (6, 8)
+    assert tuple(block.vars["dw"].shape) == (8, 4)
+
+
+def test_relu_grad_rejects_mismatched_incoming_grad():
+    block = _grad_block(x=(6, 8), g=(6, 9), dx=None)
+    with pytest.raises(ShapeError, match="relu_grad"):
+        block.append_op(type="relu_grad",
+                        inputs={"X": ["x"], "Out@GRAD": ["g"]},
+                        outputs={"X@GRAD": ["dx"]}, attrs={})
+
+
+def test_elementwise_add_grad_broadcast_bias():
+    """dY of a broadcast add keeps the bias's own (reduced) shape."""
+    block = _grad_block(x=(6, 8), b=(8,), g=(6, 8), dx=None, db=None)
+    block.append_op(type="elementwise_add_grad",
+                    inputs={"X": ["x"], "Y": ["b"], "Out@GRAD": ["g"]},
+                    outputs={"X@GRAD": ["dx"], "Y@GRAD": ["db"]}, attrs={})
+    assert tuple(block.vars["dx"].shape) == (6, 8)
+    assert tuple(block.vars["db"].shape) == (8,)
+
+
+def test_conv2d_grad_checks_filter_channels():
+    block = _grad_block(x=(2, 3, 8, 8), w=(16, 3, 3, 3),
+                        g=(2, 7, 6, 6), dw=None)
+    with pytest.raises(ShapeError, match="conv2d_grad"):
+        block.append_op(
+            type="conv2d_grad",
+            inputs={"Input": ["x"], "Filter": ["w"], "Output@GRAD": ["g"]},
+            outputs={"Filter@GRAD": ["dw"]}, attrs={})
+
+
+def test_cross_entropy_grad_batch_mismatch_raises():
+    block = _grad_block(x=(6, 10), lab=(5, 1), g=(6, 1), dx=None)
+    with pytest.raises(ShapeError, match="cross_entropy_grad"):
+        block.append_op(
+            type="cross_entropy_grad",
+            inputs={"X": ["x"], "Label": ["lab"], "Y@GRAD": ["g"]},
+            outputs={"X@GRAD": ["dx"]}, attrs={})
+
+
+def test_training_program_grads_all_have_contracts():
+    """An end-to-end SGD program's grad ops are all shape-checked: no
+    grad op in a standard MLP training program lacks a contract."""
+    from paddle_tpu.core import shape_inference
+    with program_guard(Program(), Program()):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        yp = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(yp, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        prog = fluid.default_main_program()
+    grads = [op.type for op in prog.global_block().ops
+             if op.type.endswith("_grad")]
+    assert grads
+    missing = [t for t in grads if not shape_inference.has_contract(t)]
+    assert not missing, f"grad ops without a contract: {missing}"

@@ -252,6 +252,13 @@ for key in ("off_step_ms", "on_step_ms", "off_delta_frac"):
 # FLAGS_trace=0 overhead contract: step time must not move (<=1%, with
 # an absolute floor because sub-ms CPU steps make timer jitter dominate)
 assert tr["off_delta_ok"], tr
+# FLAGS_verify contract: the checks run on the compile-cache MISS path
+# only — exactly one miss when one is forced under `basic`, zero on the
+# warm loop, and the warm verify-on step time within the trace gate
+v = result["verify"]
+assert v["misses_first_basic_loop"] == 1, v
+assert v["misses_warm_basic_loop"] == 0, v
+assert v["off_delta_ok"], v
 # fused input pipeline smoke: process decode + shm staging must name its
 # bottleneck stage, keep up with the device baseline, and leak nothing
 pl = result.get("pipeline")
@@ -314,6 +321,105 @@ fi
 JAX_PLATFORMS=cpu python -m paddle_tpu shard plan --selftest --quiet
 if [ $? -ne 0 ]; then
     echo "GATE: SHARD PLAN CLI RED — do not commit" >&2
+    exit 1
+fi
+
+# check CLI selftest: verifies a clean demo program AND an intentionally
+# broken clone (must flag PTA001) — rc 0 only when both behave
+JAX_PLATFORMS=cpu python -m paddle_tpu check --selftest --quiet
+if [ $? -ne 0 ]; then
+    echo "GATE: CHECK SELFTEST RED — do not commit" >&2
+    exit 1
+fi
+
+# check CLI over a freshly saved model: save_inference_model -> check
+# --model-dir must come back rc 0 with zero errors (the offline path
+# real deployments gate on)
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, shutil, subprocess, sys, tempfile
+import paddle_tpu as fluid
+
+tmp = tempfile.mkdtemp(prefix="check_gate_")
+try:
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.fc(input=x, size=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    model_dir = os.path.join(tmp, "model")
+    with fluid.program_guard(prog, startup):
+        fluid.io.save_inference_model(model_dir, ["x"], [y], exe)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "check",
+         "--model-dir", model_dir, "--json"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-500:])
+    report = json.loads(proc.stdout)
+    assert report["ok"], report
+    assert not report["diagnostics"], report
+    print("check --model-dir: ok")
+finally:
+    shutil.rmtree(tmp, ignore_errors=True)
+EOF
+if [ $? -ne 0 ]; then
+    echo "GATE: CHECK MODEL-DIR RED — do not commit" >&2
+    exit 1
+fi
+
+# FLAGS_verify=full smoke: the three program shapes the repo ships —
+# plain training MLP through the Executor, the zero1-rewritten program
+# with its Zero1Plan, and an autoshard ShardingPlan — must all verify
+# with ZERO findings at level full, and the peak-HBM gauge must land
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import analysis, flags, monitor
+from paddle_tpu.parallel import autoshard, zero1
+
+monitor.reset()
+flags.set("monitor", True)
+main, startup = fluid.Program(), fluid.Program()
+with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(input=x, size=16, act="relu")
+    pred = fluid.layers.fc(input=h, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.Momentum(learning_rate=0.01,
+                             momentum=0.9).minimize(loss)
+
+# 1) dryrun program through the real Executor miss path at level full
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(startup)
+with flags.flag_guard(verify="full"):
+    exe.run(main,
+            feed={"x": np.ones((4, 8), np.float32),
+                  "y": np.ones((4, 1), np.float32)},
+            fetch_list=[loss])
+snap = monitor.registry().snapshot()
+assert any(k.startswith("analysis_peak_hbm_bytes_per_replica")
+           for k in snap), sorted(snap)
+
+# 2) zero1-rewritten program + its plan
+sharded, zplan = zero1.apply(main, 8)
+r = analysis.verify(sharded, level="full", feed_names=["x", "y"],
+                    fetch_names=[loss.name], mesh_axes={"dp": 8},
+                    zplan=zplan)
+assert r.ok and not r.errors() and not r.warnings(), r.render()
+
+# 3) autoshard plan over the same program
+aplan = autoshard.build_plan(main, {"dp": 8})
+r = analysis.verify(main, level="full", feed_names=["x", "y"],
+                    fetch_names=[loss.name], mesh_axes={"dp": 8},
+                    aplan=aplan)
+assert r.ok and not r.errors() and not r.warnings(), r.render()
+assert r.hbm and r.hbm["peak_bytes_per_replica"] > 0, r.hbm
+print("verify smoke: ok")
+EOF
+if [ $? -ne 0 ]; then
+    echo "GATE: VERIFY SMOKE RED — do not commit" >&2
     exit 1
 fi
 
