@@ -15,6 +15,17 @@ Commands:
   monitor JOURNAL    summarize a FLAGS_monitor_journal step journal
                      (step/phase timings, compile-cache hit rate, replica
                      skew); --json emits the summary as JSON.
+  health summary LEDGER
+                     summarize a FLAGS_health_ledger run ledger (loss
+                     curve, grad norms, detector events, divergence
+                     step); --json emits the summary as JSON.
+  health compare A B [--tol-final F] [--tol-traj F]
+                     assert convergence parity between two run ledgers
+                     (final-loss delta, step-aligned trajectory max
+                     deviation, divergence-step agreement); rc 0 on
+                     parity, 1 on a violated tolerance, 2 on an
+                     unreadable ledger — the standard parity gate
+                     bench.py and green_gate use.
   checkpoint inspect DIR [--serial N]
                      list a checkpoint directory's serials and their
                      commit status (committed / incomplete / orphaned
@@ -100,6 +111,41 @@ def _cmd_monitor(args):
     else:
         print(format_summary(summary))
     return 0
+
+
+def _cmd_health(args):
+    import json
+
+    from .health import compare as hcompare
+    from .health.ledger import read_ledger
+
+    if args.health_action == "summary":
+        try:
+            records = read_ledger(args.ledger)
+        except OSError as e:
+            print(f"cannot read ledger: {e}", file=sys.stderr)
+            return 2
+        summary = hcompare.summarize_ledger(records)
+        if args.json:
+            print(json.dumps(summary, indent=2))
+        else:
+            print(hcompare.format_ledger_summary(summary))
+        return 0
+    if args.health_action == "compare":
+        try:
+            a = read_ledger(args.a)
+            b = read_ledger(args.b)
+        except OSError as e:
+            print(f"cannot read ledger: {e}", file=sys.stderr)
+            return 2
+        report = hcompare.compare_ledgers(
+            a, b, tol_final=args.tol_final, tol_traj=args.tol_traj)
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            print(hcompare.format_compare(report))
+        return 0 if report["ok"] else 1
+    return 2
 
 
 def _cmd_checkpoint(args):
@@ -665,6 +711,29 @@ def main(argv=None):
     m.add_argument("--json", action="store_true",
                    help="emit the summary as JSON instead of a table")
 
+    h = sub.add_parser("health", help="model-health run ledgers: "
+                                      "summarize and assert convergence "
+                                      "parity")
+    hsub = h.add_subparsers(dest="health_action", required=True)
+    hs = hsub.add_parser("summary", help="summarize a FLAGS_health_ledger "
+                                         "run ledger")
+    hs.add_argument("ledger", help="path of the JSONL health ledger")
+    hs.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of a table")
+    hc = hsub.add_parser("compare", help="convergence parity between two "
+                                         "run ledgers (rc 0 parity / "
+                                         "1 fail / 2 unreadable)")
+    hc.add_argument("a", help="reference run ledger")
+    hc.add_argument("b", help="candidate run ledger")
+    hc.add_argument("--tol-final", type=float, default=1e-3,
+                    help="max |final loss A - final loss B| at the last "
+                         "common sampled step")
+    hc.add_argument("--tol-traj", type=float, default=5e-3,
+                    help="max step-aligned |loss A - loss B| over all "
+                         "common sampled steps")
+    hc.add_argument("--json", action="store_true",
+                    help="emit the parity report as JSON")
+
     c = sub.add_parser("checkpoint", help="inspect checkpoint directories")
     csub = c.add_subparsers(dest="checkpoint_action", required=True)
     ci = csub.add_parser("inspect", help="list serials, commit status and "
@@ -836,6 +905,8 @@ def main(argv=None):
             return _cmd_flags(args)
         if args.command == "monitor":
             return _cmd_monitor(args)
+        if args.command == "health":
+            return _cmd_health(args)
         if args.command == "checkpoint":
             return _cmd_checkpoint(args)
         if args.command == "shard":

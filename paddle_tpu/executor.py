@@ -23,6 +23,7 @@ from .core.lod_tensor import LoDTensor
 from .core.places import CPUPlace, TPUPlace, jax_device_for
 from .core.scope import global_scope, Scope
 from .core.registry import SeqTensor
+from . import health as _health
 from .resilience import chaos as _chaos
 from .resilience import watchdog as _watchdog
 from .trace import costs as _trace_costs
@@ -450,6 +451,7 @@ class Executor:
         state_names, state_out_names = executor_core.collect_state_names(program, scope)
         if flags.get("debug_nans"):
             donate_feeds = False  # re-run needs the inputs (see below)
+        hplan = _health.plan_if_enabled(program)
         cache_key = (
             id(program),
             program._mutation,
@@ -461,6 +463,7 @@ class Executor:
             flags.get("debug_nans"),  # changes donation (see below)
             ("wire", wire.fingerprint() if wire is not None else None),
             ("donate_feeds", donate_feeds),
+            ("health", hplan.digest if hplan is not None else None),
         )
         entry = self._compile_cache.get(cache_key) if use_cache else None
         fp = monitor.fingerprint_of(cache_key) if mon is not None else None
@@ -478,10 +481,16 @@ class Executor:
                 donate_state=not flags.get("debug_nans"),
                 context="executor")
             tb = time.perf_counter()
-            step = executor_core.build_step_fn(program, fetch_names, state_out_names)
+            built_fetch = (list(fetch_names) + hplan.fetch_names
+                           if hplan is not None else fetch_names)
+            step = executor_core.build_step_fn(program, built_fetch, state_out_names)
             if wire is not None:
                 step = wire.wrap_step(
                     step, var_dtypes=self._wire_var_dtypes(program, wire))
+            if hplan is not None:
+                # fold the appended grad fetches into one [4]-stat leaf
+                # per param INSIDE the jit (health/stats.py)
+                step = hplan.wrap_step(step, len(fetch_names))
             probe = monitor.compile_probe(fp) \
                 if mon is not None and flags.get("monitor_hlo_cost") else None
             # under debug_nans the trap fires INSIDE compiled() before the
@@ -505,10 +514,15 @@ class Executor:
             if isinstance(v, LoDTensor):
                 v = executor_core.feed_to_tracevalue(v)
             (mut_state if n in out_set else const_state)[n] = v
+        step0 = self._step_counter.get(id(program), 0)
         rng = self._rng_for(program)
         t0 = time.perf_counter() if flags.get("benchmark") else None
         tc = time.perf_counter() if mon is not None else None
         fetches, new_mut = compiled(mut_state, const_state, feed_vals, rng)
+        hstats = None
+        if hplan is not None:
+            hstats = fetches[-1]
+            fetches = fetches[:-1]
         if mon is not None:
             call_s = time.perf_counter() - tc
             if was_miss:
@@ -553,6 +567,9 @@ class Executor:
                                "distribution").observe(g.value)
             print(f"[paddle_tpu] run: {g.value:.3f}"
                   f" ms (fetches={len(fetches)}){mem}", file=sys.stderr)
+        if hstats is not None:
+            _health.on_step(step0, None, hstats, fetch_names, fetches,
+                            mon=mon, kind="executor")
         if flags.get("check_nan_inf"):
             # per-op blame isn't available inside one XLA computation; check
             # the step boundary (fetches + updated state) and name the var
@@ -583,6 +600,7 @@ class Executor:
                 f"exe.run) first.")
         if flags.get("debug_nans"):
             donate_feeds = False  # the op-by-op re-run needs the inputs
+        hplan = _health.plan_if_enabled(program)
         cache_key = (
             id(program),
             program._mutation,
@@ -598,6 +616,7 @@ class Executor:
             ("iters", iters),
             ("wire", wire.fingerprint() if wire is not None else None),
             ("donate_feeds", donate_feeds),
+            ("health", hplan.digest if hplan is not None else None),
         )
         out_set = set(state_out_names)
         mut_state, const_state = {}, {}
@@ -620,8 +639,10 @@ class Executor:
                 donate_state=not flags.get("debug_nans"),
                 context="executor")
             tb = time.perf_counter()
+            built_fetch = (list(fetch_names) + hplan.fetch_names
+                           if hplan is not None else fetch_names)
             step = executor_core.build_step_fn(
-                program, fetch_names, state_out_names)
+                program, built_fetch, state_out_names)
             if wire is not None:
                 # decode INSIDE the per-step fn: the scan slices the compact
                 # [K, ...] wire chunk and each iteration casts/scales only
@@ -629,6 +650,11 @@ class Executor:
                 # as [K, ...] in device memory
                 step = wire.wrap_step(
                     step, var_dtypes=self._wire_var_dtypes(program, wire))
+            if hplan is not None:
+                # reduce the appended grad fetches to [4]-stat leaves per
+                # step BEFORE the scan wraps them — the scan then stacks
+                # tiny stats, never raw [K, ...] gradients
+                step = hplan.wrap_step(step, len(fetch_names))
             ema = executor_core.collect_ema_states(
                 program, state_out_names, fetch_names) \
                 if flags.get("fold_ema_multi_step") else {}
@@ -697,6 +723,10 @@ class Executor:
                jnp.asarray(step0, jnp.int32))
         tc = time.perf_counter() if mon is not None else None
         fetches, new_mut = compiled(mut_state, const_state, feed_vals, rng)
+        hstats = None
+        if hplan is not None:
+            hstats = fetches[-1]
+            fetches = fetches[:-1]
         if mon is not None:
             call_s = time.perf_counter() - tc
             if was_miss:  # first call compiles under async dispatch
@@ -717,6 +747,9 @@ class Executor:
             new_mut = plain
         for n, v in new_mut.items():
             scope.set_var(n, v)
+        if hstats is not None:
+            _health.on_step(step0, iters, hstats, fetch_names, fetches,
+                            mon=mon, kind="executor")
         if flags.get("check_nan_inf"):
             executor_core.check_values_finite(
                 list(zip(fetch_names, fetches)) + list(new_mut.items()),

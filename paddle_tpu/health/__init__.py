@@ -1,0 +1,164 @@
+"""paddle_tpu.health — fused on-device model-health telemetry.
+
+The monitor (PR 3) observes the process and trace (PR 6) observes
+requests; this package observes the MODEL: per-param grad/weight norms,
+update ratios and non-finite counts fused into the compiled step fn
+(stats.py), a JSONL run ledger + gauges (ledger.py), convergence
+detectors wired into trace dumps and the resilience policy
+(detectors.py), and a run-parity comparison engine behind
+`python -m paddle_tpu health summary|compare` (compare.py).
+
+Executor integration (executor.py / parallel_executor.py):
+
+    hplan = health.plan_if_enabled(program)     # None when FLAGS_health=0
+    ... cache key gains ("health", hplan.digest or None) ...
+    step  = executor_core.build_step_fn(
+        program, fetch_names + hplan.fetch_names, ...)
+    step  = hplan.wrap_step(step, len(fetch_names))   # after wire wrap,
+                                                      # before pack/scan
+    ... run; stats = fetches.pop() ...
+    health.on_step(step0, iters, stats, fetch_names, fetches, mon=mon)
+
+See docs/observability.md ("Model health") for flags and tuning.
+"""
+
+import math
+import time
+
+import numpy as np
+
+from .. import flags
+from . import compare, detectors, ledger, stats
+from .compare import compare_ledgers, format_compare, summarize_ledger
+from .detectors import DetectorBank, drain_events, pending_events
+from .ledger import read_ledger
+from .stats import (HealthPlan, STAT_FIELDS, plan_for, plan_if_enabled)
+
+__all__ = [
+    "HealthPlan", "STAT_FIELDS", "plan_for", "plan_if_enabled",
+    "on_step", "enabled", "last_record", "reset",
+    "DetectorBank", "drain_events", "pending_events",
+    "read_ledger", "summarize_ledger", "compare_ledgers",
+    "format_compare",
+    "compare", "detectors", "ledger", "stats",
+]
+
+_bank = DetectorBank()
+_last = {"record": None}
+
+
+def enabled():
+    return bool(flags.get("health"))
+
+
+def last_record():
+    """The most recent sampled record (tests / notebooks)."""
+    return _last["record"]
+
+
+def _find_loss(fetch_names, fetches, k, multi):
+    """First float fetch that is one scalar per step — the documented
+    loss heuristic (fetch the loss first to feed the detectors)."""
+    for v in fetches or ():
+        try:
+            arr = np.asarray(v)
+        except Exception:
+            continue
+        if arr.dtype.kind != "f":
+            continue
+        if multi:
+            if arr.ndim >= 1 and arr.shape[0] == k and arr.size == k:
+                return arr.reshape(k).astype(np.float64)
+        elif arr.size == 1:
+            return arr.reshape(1).astype(np.float64)
+    return None
+
+
+def _chaos_scales(step):
+    """(loss_scale, grad_scale) from the installed chaos monkey."""
+    from ..resilience import chaos  # lazy: resilience imports health
+
+    monkey = chaos.active()
+    if monkey is None:
+        return 1.0, 1.0
+    return monkey.poison_health(step)
+
+
+def on_step(step0, iters, stats_dev, fetch_names, fetches,
+            mon=None, kind="executor"):
+    """Host side of the health path: sample, journal, detect.
+
+    Called by the executors after a health-wrapped dispatch with the
+    stats pytree popped off the fetch list. `step0` is the program step
+    index of the first iteration in the dispatch; `iters` is None for a
+    single step or the scan length K. Steps where
+    `step % FLAGS_health_interval != 0` cost nothing on the host — the
+    device stats leaves are simply dropped without a readback.
+    """
+    interval = max(1, int(flags.get("health_interval") or 1))
+    multi = iters is not None
+    k = int(iters) if multi else 1
+    sampled = [i for i in range(k) if (step0 + i) % interval == 0]
+    if not sampled:
+        return
+    host = {label: np.asarray(v, dtype=np.float64).reshape(k, len(
+        STAT_FIELDS)) for label, v in stats_dev.items()}
+    loss_vec = _find_loss(fetch_names, fetches, k, multi)
+    last_rec = None
+    for i in sampled:
+        step = step0 + i
+        params, nonfinite, gsq_total = {}, 0, 0.0
+        for label, a in sorted(host.items()):
+            gsq, wsq, dsq, bad = (float(x) for x in a[i])
+            gn = math.sqrt(gsq) if gsq >= 0 else float("nan")
+            wn = math.sqrt(wsq) if wsq >= 0 else float("nan")
+            dn = math.sqrt(dsq) if dsq >= 0 else float("nan")
+            params[label] = {
+                "grad_norm": gn,
+                "weight_norm": wn,
+                "update_ratio": (dn / wn) if wn > 0 else 0.0,
+                "nonfinite": int(bad),
+            }
+            if bad:
+                nonfinite += 1
+            gsq_total += gsq
+        loss = float(loss_vec[i]) if loss_vec is not None else None
+        ggn = math.sqrt(gsq_total) if gsq_total >= 0 else float("nan")
+
+        loss_scale, grad_scale = _chaos_scales(step)
+        if loss is not None and loss_scale != 1.0:
+            loss *= loss_scale
+        if grad_scale != 1.0:
+            for st in params.values():
+                st["grad_norm"] *= grad_scale
+            ggn *= grad_scale
+
+        rec = {"ts": time.time(), "step": int(step), "kind": kind,
+               "loss": loss, "global_grad_norm": ggn,
+               "nonfinite_params": nonfinite, "params": params}
+        rec["events"] = _bank.observe(rec)  # also sets rec["loss_ema"]
+        ledger.write_record(rec)
+        ledger.set_gauges(rec)
+        last_rec = rec
+    _last["record"] = last_rec
+    if mon is not None and last_rec is not None:
+        if mon.extra is None:
+            mon.extra = {}
+        mon.extra["health"] = {
+            "step": last_rec["step"],
+            "loss": last_rec["loss"],
+            "loss_ema": last_rec["loss_ema"],
+            "global_grad_norm": last_rec["global_grad_norm"],
+            "nonfinite_params": last_rec["nonfinite_params"],
+            "events": last_rec["events"],
+        }
+
+
+def reset():
+    """Forget plans, detector state, queued events, and the ledger
+    writer (tests; also lets one process run independent experiments)."""
+    stats.reset()
+    ledger.reset()
+    detectors.reset()
+    _bank.reset()
+    _last["record"] = None

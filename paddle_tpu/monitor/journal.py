@@ -30,10 +30,19 @@ Schema (one object per line; optional fields omitted when absent):
 """
 
 import json
+import os
 import threading
+
+from .. import flags
 
 __all__ = ["JournalWriter", "read_journal", "summarize_journal",
            "format_summary"]
+
+flags.define("monitor_journal_max_mb", float, 0.0,
+             "Size-gated journal rotation: when a JSONL journal (monitor "
+             "step journal, health ledger) grows past this many MB it "
+             "rolls over to <path>.1 (one rollover segment kept; "
+             "read_journal transparently reads the pair). 0 = unbounded.")
 
 
 def _default(o):
@@ -65,8 +74,27 @@ class JournalWriter:
     def write(self, record):
         line = json.dumps(record, default=_default)
         with self._lock:
+            if self._f is None:
+                return
             self._f.write(line + "\n")
             self._f.flush()
+            self._maybe_rotate()
+
+    def _maybe_rotate(self):
+        """Roll the journal over to <path>.1 once it outgrows
+        FLAGS_monitor_journal_max_mb (caller holds the lock)."""
+        max_mb = flags.get("monitor_journal_max_mb")
+        if not max_mb or max_mb <= 0:
+            return
+        try:
+            size = self._f.tell()
+        except OSError:
+            return
+        if size <= max_mb * 1e6:
+            return
+        self._f.close()
+        os.replace(self.path, self.path + ".1")
+        self._f = open(self.path, "a")
 
     def close(self):
         with self._lock:
@@ -79,23 +107,28 @@ def read_journal(path):
     """Parse a JSONL journal -> list of step records (skips blank lines;
     a torn line — crash mid-write — is dropped with a warning, not
     fatal: the reader should know records went missing, silently eating
-    them hid real data loss)."""
+    them hid real data loss). When a rotation segment `<path>.1` exists
+    (FLAGS_monitor_journal_max_mb rollover) it is read first, so the
+    caller sees the pair as one chronological journal."""
     import warnings
 
+    rolled = str(path) + ".1"
+    paths = ([rolled] if os.path.exists(rolled) else []) + [str(path)]
     records = []
-    with open(path) as f:
-        for lineno, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                records.append(json.loads(line))
-            except json.JSONDecodeError as e:
-                warnings.warn(
-                    f"journal {path}: skipping unparseable line "
-                    f"{lineno} ({e}) — truncated write?",
-                    RuntimeWarning, stacklevel=2)
-                continue
+    for p in paths:
+        with open(p) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError as e:
+                    warnings.warn(
+                        f"journal {p}: skipping unparseable line "
+                        f"{lineno} ({e}) — truncated write?",
+                        RuntimeWarning, stacklevel=2)
+                    continue
     return records
 
 

@@ -44,6 +44,7 @@ from .core.lod_tensor import LoDTensor
 from .core.registry import SeqTensor
 from .core.scope import global_scope
 from .executor import as_numpy, _apply_debug_nans
+from . import health as _health
 from .parallel import autoshard as _autoshard
 from .parallel import zero1 as _zero1
 from .resilience import chaos as _chaos
@@ -423,6 +424,10 @@ class ParallelExecutor:
             mon.phase("feed_encode", time.perf_counter() - t_enc)
 
         state_names, state_out_names = executor_core.collect_state_names(program, scope)
+        # health sees the RESOLVED program, so under zero1 the plan pairs
+        # the canonical param with its reduce-scattered [N, shard] grad —
+        # shard-local reductions, no regather (health/stats.py)
+        hplan = _health.plan_if_enabled(program)
         cache_key = (
             id(program),
             program._mutation,
@@ -437,6 +442,7 @@ class ParallelExecutor:
             ("donate_feeds", donate_feeds),
             ("zero1", use_zero1, gss, dp_n),
             ("autoshard", aplan.digest() if aplan is not None else None),
+            ("health", hplan.digest if hplan is not None else None),
         )
         entry = self._compile_cache.get(cache_key)
         fp = monitor.fingerprint_of(cache_key) if mon is not None else None
@@ -462,8 +468,10 @@ class ParallelExecutor:
                 constraints = {
                     n: NamedSharding(self._mesh, P(*s))
                     for n, s in aplan.boundary_specs().items()}
+            built_fetch = (list(fetch_names) + hplan.fetch_names
+                           if hplan is not None else fetch_names)
             step = executor_core.build_step_fn(
-                program, fetch_names, state_out_names,
+                program, built_fetch, state_out_names,
                 constraints=constraints)
             if wire is not None:
                 # decode in the PER-STEP fn (before the scan wrapper), so
@@ -473,6 +481,11 @@ class ParallelExecutor:
                     n: gb.vars[n].dtype for n in wire
                     if n in gb.vars and gb.vars[n].dtype is not None}
                 step = wire.wrap_step(step, var_dtypes=var_dtypes)
+            if hplan is not None:
+                # per-step stats reduction before any scan wrapper, so a
+                # K-step scan stacks [4]-stat leaves, not raw grads; GSPMD
+                # lowers the reductions shard-locally under the mesh
+                step = hplan.wrap_step(step, len(fetch_names))
             if iters is not None:
                 missing = [n for n in state_out_names
                            if not scope.has_var(n)]
@@ -541,6 +554,7 @@ class ParallelExecutor:
             (mut_state if n in out_set else const_state)[n] = v
 
         base_key = jax.random.PRNGKey(program.random_seed)
+        step0 = self._step
         if iters is not None:
             # multi-step scan folds base at step0+i internally — same rng
             # stream as iters sequential run() calls (executor_core
@@ -556,6 +570,10 @@ class ParallelExecutor:
         tc = time.perf_counter() if mon is not None else None
         with _watchdog.armed("parallel_executor"), self._mesh:
             fetches, new_mut = compiled(mut_state, const_state, feed_vals, rng)
+        hstats = None
+        if hplan is not None:
+            hstats = fetches[-1]
+            fetches = fetches[:-1]
         replica_ms = replica_ids = None
         if mon is not None:
             if flags.get("monitor_replica_skew"):
@@ -577,6 +595,9 @@ class ParallelExecutor:
                 mon.phase("dispatch", call_s)
         for n, v in new_mut.items():
             scope.set_var(n, v)
+        if hstats is not None:
+            _health.on_step(step0, iters, hstats, fetch_names, fetches,
+                            mon=mon, kind="parallel_executor")
         if was_miss and flags.get("verify") == "full":
             # measured counterpart of the analysis_peak_hbm gauge: bytes
             # actually resident on one device for this step's state (the

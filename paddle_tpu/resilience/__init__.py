@@ -27,6 +27,13 @@ flags.define("resilience_nan_policy", str, "raise",
              "What the NaN/Inf loss guard does on a non-finite metric: "
              "raise (NanLossError), skip (count and continue), or "
              "restore (roll back to the last checkpoint).")
+flags.define("resilience_health_policy", str, "warn",
+             "What ResilientRunner does when a paddle_tpu.health "
+             "detector fired during the step (loss spike, grad "
+             "explosion, divergence, ...): warn (count and continue), "
+             "skip (count the step as suspect and continue), or restore "
+             "(roll back to the last checkpoint). The NaN-only guard "
+             "(resilience_nan_policy) stays its own special case.")
 flags.define("step_deadline_ms", int, 0,
              "Hang watchdog: if one executor dispatch exceeds this many "
              "milliseconds, dump every thread's stack (and the chrome "

@@ -13,6 +13,8 @@ raises UNAVAILABLE, twice" and prove the retry path end to end:
     replica_kill os.kill(self, SIGKILL)                    (keyed on run-call index)
     replica_hang sleep delay_ms, holding the dispatch      (keyed on run-call index)
     worker_kill  SIGKILL a datapipe decode worker process  (keyed on map-item index)
+    loss_spike   scale the health-recorded loss by `scale` (keyed on global step)
+    grad_explode scale the health-recorded grad norms      (keyed on global step)
 
 delay/transient count *executor run calls* because that is what retry
 wraps (a retried step consumes several run-call indices — set `times` to
@@ -42,7 +44,7 @@ __all__ = ["Fault", "ChaosMonkey", "install", "uninstall", "active",
            "on_run", "on_map_dispatch"]
 
 _KINDS = ("delay", "transient", "nan", "sigterm", "replica_kill",
-          "replica_hang", "worker_kill")
+          "replica_hang", "worker_kill", "loss_spike", "grad_explode")
 
 # a "hung" replica is dead-but-connected: default far past any sane
 # request deadline so the router's probes, not patience, end the wait
@@ -50,7 +52,8 @@ _HANG_DEFAULT_MS = 3_600_000.0
 
 
 class Fault:
-    def __init__(self, kind, at, times=1, delay_ms=None, label=None):
+    def __init__(self, kind, at, times=1, delay_ms=None, label=None,
+                 scale=1e3):
         if kind not in _KINDS:
             raise ValueError(f"kind must be one of {_KINDS}, got {kind!r}")
         if delay_ms is None:
@@ -61,6 +64,7 @@ class Fault:
         self.times = int(times)  # consecutive occurrences from `at`
         self.delay_ms = float(delay_ms)
         self.label = label       # None = any executor; else exact match
+        self.scale = float(scale)  # loss_spike/grad_explode multiplier
         self.fired = 0
 
     def _covers(self, n):
@@ -144,6 +148,22 @@ class ChaosMonkey:
                 self._fire(f, step)
                 return _poison_tree(metrics)
         return metrics
+
+    def poison_health(self, step):
+        """Health hook: (loss_scale, grad_scale) to apply to the stats
+        RECORDED for global step `step` — the detector drill faults.
+        loss_spike multiplies the journaled loss, grad_explode the
+        journaled grad norms, by `scale`; the training math is untouched
+        (the drill proves the detectors, not the optimizer)."""
+        loss_scale = grad_scale = 1.0
+        for f in self.faults:
+            if f.kind == "loss_spike" and f._covers(step):
+                self._fire(f, step, "health")
+                loss_scale *= f.scale
+            elif f.kind == "grad_explode" and f._covers(step):
+                self._fire(f, step, "health")
+                grad_scale *= f.scale
+        return loss_scale, grad_scale
 
 
 def _poison_tree(value):

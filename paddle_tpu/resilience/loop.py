@@ -21,7 +21,7 @@ RolledBack — the caller re-enters its iteration loop, which resumes from
 the restored source position.
 """
 
-from .. import monitor
+from .. import flags, monitor
 from . import chaos as chaos_mod
 from .checkpoint import CheckpointManager
 from .errors import NanLossError
@@ -30,6 +30,8 @@ from .preempt import PreemptionHandler
 from .retry import RetryPolicy
 
 __all__ = ["ResilienceConfig", "ResilientRunner", "RolledBack"]
+
+_HEALTH_POLICIES = ("warn", "skip", "restore")
 
 
 class RolledBack(Exception):
@@ -54,6 +56,9 @@ class ResilienceConfig:
     retry:                   RetryPolicy, None = default policy, False =
                              no retries
     nan_policy:              raise|skip|restore; None = the flag
+    health_policy:           warn|skip|restore applied when paddle_tpu
+                             .health detectors fired during the step;
+                             None = FLAGS_resilience_health_policy
     handle_signals:          install SIGTERM/SIGINT handlers in session()
     save_on_preempt:         blocking grace-save before raising Preempted
     restore_on_start:        restore() picks up the latest checkpoint
@@ -61,14 +66,16 @@ class ResilienceConfig:
 
     def __init__(self, checkpoint_dir=None, checkpoint_interval=0,
                  max_num_checkpoints=3, async_checkpoints=True,
-                 retry=None, nan_policy=None, handle_signals=True,
-                 save_on_preempt=True, restore_on_start=True):
+                 retry=None, nan_policy=None, health_policy=None,
+                 handle_signals=True, save_on_preempt=True,
+                 restore_on_start=True):
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_interval = int(checkpoint_interval)
         self.max_num_checkpoints = int(max_num_checkpoints)
         self.async_checkpoints = bool(async_checkpoints)
         self.retry = retry
         self.nan_policy = nan_policy
+        self.health_policy = health_policy
         self.handle_signals = bool(handle_signals)
         self.save_on_preempt = bool(save_on_preempt)
         self.restore_on_start = bool(restore_on_start)
@@ -180,6 +187,33 @@ class ResilientRunner:
             pipe=pipe, extra=merged, block=block)
 
     # ---------------------------------------------------------------- step
+    def _apply_health_policy(self, pipe):
+        """Generalized model-health guard: drain the detector events the
+        step's health sampling queued (paddle_tpu.health.detectors) and
+        apply warn|skip|restore. The NaN-only guard above stays its own
+        special case — it reads the fetched metrics directly and can
+        raise, while this path reacts to the fused-stats detectors."""
+        from .. import health  # lazy: this package is imported early
+
+        events = health.drain_events()
+        if not events:
+            return
+        policy = self.config.health_policy \
+            or flags.get("resilience_health_policy")
+        if policy not in _HEALTH_POLICIES:
+            raise ValueError(
+                f"resilience_health_policy must be one of "
+                f"{_HEALTH_POLICIES}, got {policy!r}")
+        monitor.registry().counter(
+            "health_policy_actions_total",
+            help="health detector events handled by the resilience "
+                 "policy", policy=policy).inc(len(events))
+        if policy == "restore":
+            self._rollback(pipe)  # raises RolledBack
+        if policy == "skip":
+            self.state["health_skipped_steps"] = int(
+                self.state.get("health_skipped_steps", 0)) + 1
+
     def run_step(self, fn):
         """Run one step (the exe.run closure) under the retry policy."""
         if self.retry is None:
@@ -189,13 +223,15 @@ class ResilientRunner:
     def after_step(self, metrics, pipe=None, extra=None):
         """Step-boundary bookkeeping; call after every successful
         run_step. Returns the (possibly chaos-poisoned) metrics. Raises
-        RolledBack (nan restore) or Preempted (grace-saved signal)."""
+        RolledBack (nan/health restore) or Preempted (grace-saved
+        signal)."""
         s = self.global_step  # 0-based index of the step that just ran
         monkey = chaos_mod.active()
         if monkey is not None:
             metrics = monkey.poison(s, metrics)
         if self.guard.check(metrics, step=s) == "restore":
             self._rollback(pipe)  # raises RolledBack
+        self._apply_health_policy(pipe)  # may raise RolledBack
         self.global_step = s + 1
         if extra:
             self.state.update(extra)

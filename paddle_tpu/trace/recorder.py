@@ -47,6 +47,12 @@ flags.define(
     "Minimum seconds between automatic flight-recorder dumps PER trigger "
     "reason (maybe_dump) — an SLO-violation storm produces one "
     "post-mortem, not one per request. 0 = dump every trigger.")
+flags.define(
+    "trace_dump_keep", int, 0,
+    "Retention cap on trace_<reason>_<n>/ dump directories in the dump "
+    "directory: after each dump the oldest beyond this many are pruned, "
+    "so a detector/anomaly storm cannot leak disk without bound. "
+    "0 = keep everything.")
 
 _lock = threading.Lock()
 _rings = []          # [(thread_name, _Ring)] — grows per recording thread
@@ -154,7 +160,37 @@ def dump(reason="manual", out_dir=None):
         "trace_dumps_total",
         help="flight-recorder dumps written, by trigger reason",
         reason=reason).inc()
+    _prune_dumps(base)
     return path
+
+
+_DUMP_DIR_RE = re.compile(r"^trace_.+_\d+$")
+
+
+def _prune_dumps(base):
+    """FLAGS_trace_dump_keep retention: remove the oldest trace_*_<n>/
+    siblings beyond the cap. Best-effort — retention must never fail the
+    dump that triggered it."""
+    keep = flags.get("trace_dump_keep")
+    if not keep or keep <= 0:
+        return
+    try:
+        dirs = []
+        for name in os.listdir(base):
+            p = os.path.join(base, name)
+            if _DUMP_DIR_RE.match(name) and os.path.isdir(p):
+                dirs.append((os.path.getmtime(p), name, p))
+        dirs.sort()
+        for _, _, p in dirs[:max(0, len(dirs) - int(keep))]:
+            import shutil
+
+            shutil.rmtree(p, ignore_errors=True)
+            monitor.registry().counter(
+                "trace_dumps_pruned_total",
+                help="flight-recorder dumps removed by the "
+                     "FLAGS_trace_dump_keep retention cap").inc()
+    except OSError:
+        pass
 
 
 def maybe_dump(reason):

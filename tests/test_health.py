@@ -1,0 +1,504 @@
+"""paddle_tpu.health: fused on-device model-health telemetry.
+
+Contract under test:
+
+  * stats.py fuses per-param grad/weight L2 norms, update ratios and
+    non-finite counts into the compiled step fn — the sampled record must
+    match a numpy reference computed from explicitly fetched grads, on
+    the single-device Executor AND through the ParallelExecutor under
+    zero1 + autoshard on the 8-device virtual mesh (shard-local
+    reductions, canonical param names).
+  * detectors.py fires loss_spike / grad_explode / grad_vanish /
+    loss_divergence / loss_plateau / *_nonfinite with no false positives
+    on a cleanly decaying curve.
+  * ledger.py journals JSONL with torn-line tolerance and
+    FLAGS_monitor_journal_max_mb rotation; compare.py + the CLI certify
+    run parity (rc 0) or flag a diverged run (rc 1) / unreadable (rc 2).
+  * chaos loss_spike / grad_explode faults scale the sampled record so
+    the detectors see them; resilience maps queued events through
+    FLAGS_resilience_health_policy (warn | skip | restore).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import health
+from paddle_tpu.flags import flag_guard
+from paddle_tpu.health.detectors import DetectorBank
+from paddle_tpu.parallel import set_sharding
+from paddle_tpu.parallel_executor import BuildStrategy, ParallelExecutor
+
+
+@pytest.fixture(autouse=True)
+def _fresh_health():
+    health.reset()
+    yield
+    health.reset()
+
+
+def _build_net(seed=7, in_dim=6, hidden=5):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[in_dim], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=hidden, act="relu")
+        p = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        main.random_seed = startup.random_seed = seed
+    return main, startup, loss
+
+
+def _data(n=16, in_dim=6, seed=1):
+    rs = np.random.RandomState(seed)
+    xs = rs.randn(n, in_dim).astype(np.float32)
+    ys = (xs.sum(axis=1, keepdims=True) * 0.3).astype(np.float32)
+    return xs, ys
+
+
+def _var(scope, name):
+    return np.asarray(
+        fluid.executor._ensure_addressable(scope.find_var(name)),
+        dtype=np.float64)
+
+
+# ---------------------------------------------------------------- detectors
+
+
+def test_spike_fires_on_excursion_only():
+    bank = DetectorBank()
+    seen = []
+    for i in range(10):
+        seen += bank.observe({"step": i, "loss": 1.0 + 0.01 * (i % 3)})
+    assert seen == []
+    assert "loss_spike" in bank.observe({"step": 10, "loss": 100.0})
+
+
+def test_clean_decay_no_false_positives():
+    bank = DetectorBank()
+    seen = []
+    for i in range(50):
+        seen += bank.observe({"step": i, "loss": 2.0 * (0.9 ** i),
+                              "global_grad_norm": 1.0 / (i + 1),
+                              "nonfinite_params": 0})
+    assert seen == []
+
+
+def test_grad_explode_absolute_and_relative():
+    bank = DetectorBank()
+    for i in range(6):
+        assert bank.observe({"step": i, "global_grad_norm": 1.0}) == []
+    # absolute threshold (FLAGS_health_grad_explode = 1e4)
+    assert "grad_explode" in bank.observe(
+        {"step": 6, "global_grad_norm": 2e4})
+    # relative threshold: > 100x the rolling median of ~1.0
+    assert "grad_explode" in bank.observe(
+        {"step": 7, "global_grad_norm": 500.0})
+    # exploded samples stay out of the baseline: a normal one is quiet
+    assert bank.observe({"step": 8, "global_grad_norm": 1.1}) == []
+
+
+def test_grad_vanish():
+    bank = DetectorBank()
+    assert "grad_vanish" in bank.observe(
+        {"step": 0, "global_grad_norm": 1e-12})
+
+
+def test_nonfinite_loss_and_params():
+    bank = DetectorBank()
+    ev = bank.observe({"step": 0, "loss": float("nan"),
+                       "nonfinite_params": 2})
+    assert "loss_nonfinite" in ev
+    assert "param_nonfinite" in ev
+
+
+def test_divergence_fires_when_ema_leaves_best():
+    bank = DetectorBank()
+    with flag_guard(health_ema=0.0):  # EMA == raw loss: fires immediately
+        assert bank.observe({"step": 0, "loss": 0.1}) == []
+        assert "loss_divergence" in bank.observe({"step": 1, "loss": 50.0})
+
+
+def test_plateau_gated_off_by_default_and_rearms():
+    bank = DetectorBank()
+    for i in range(30):  # patience=0: plateau detection off
+        assert "loss_plateau" not in bank.observe({"step": i, "loss": 1.0})
+    bank = DetectorBank()
+    with flag_guard(health_plateau_patience=5):
+        fired = [i for i in range(20)
+                 if "loss_plateau" in bank.observe({"step": i, "loss": 1.0})]
+    assert len(fired) >= 2
+    assert fired[1] - fired[0] >= 5  # re-armed, not firing every step
+
+
+# ------------------------------------------------------------------- ledger
+
+
+def test_ledger_roundtrip_and_torn_line(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    with flag_guard(health_ledger=path):
+        health.ledger.write_record({"step": 0, "loss": 1.0})
+        health.ledger.write_record({"step": 1, "loss": 0.5})
+        health.ledger.reset()  # close before appending the torn line
+    with open(path, "a") as f:
+        f.write('{"step": 2, "loss":')  # crash mid-write
+    with pytest.warns(RuntimeWarning):
+        records = health.read_ledger(path)
+    assert [r["step"] for r in records] == [0, 1]
+    assert records[1]["loss"] == 0.5
+
+
+def test_journal_rotation_rolls_and_reads_pair(tmp_path):
+    from paddle_tpu.monitor.journal import JournalWriter, read_journal
+
+    path = str(tmp_path / "j.jsonl")
+    with flag_guard(monitor_journal_max_mb=0.0005):  # ~500 bytes
+        w = JournalWriter(path)
+        for i in range(100):
+            w.write({"step": i, "pad": "x" * 50})
+        w.close()
+    assert os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".2")  # one rollover segment kept
+    steps = [r["step"] for r in read_journal(path)]
+    assert steps == sorted(steps)  # .1 first, then the live segment
+    assert steps[-1] == 99
+
+
+def test_trace_dump_retention(tmp_path):
+    from paddle_tpu import trace
+
+    try:
+        with flag_guard(trace=True, trace_dump_keep=2,
+                        trace_dump_dir=str(tmp_path)):
+            for _ in range(5):
+                trace.dump(reason="retention")
+            dirs = [d for d in os.listdir(tmp_path)
+                    if d.startswith("trace_")]
+            assert len(dirs) == 2, dirs
+            # newest two survive (seq is monotone per process)
+            seqs = sorted(int(d.rsplit("_", 1)[1]) for d in dirs)
+            assert seqs[1] - seqs[0] == 1
+    finally:
+        trace.reset()
+
+
+# ------------------------------------------------------------------ compare
+
+
+def _records(losses, events_at=None):
+    return [{"step": i, "loss": float(v),
+             "events": ["loss_spike"] if events_at == i else []}
+            for i, v in enumerate(losses)]
+
+
+def test_compare_parity_and_both_failure_modes():
+    a = _records([1.0, 0.8, 0.6, 0.5])
+    rep = health.compare_ledgers(a, _records([1.0, 0.8, 0.6, 0.5]))
+    assert rep["ok"] and all(rep["checks"].values())
+    # final-loss + trajectory violation
+    rep2 = health.compare_ledgers(a, _records([1.0, 0.8, 0.9, 0.9]))
+    assert not rep2["ok"]
+    assert not rep2["checks"]["final_loss"]
+    assert not rep2["checks"]["trajectory"]
+    # divergence disagreement alone fails parity
+    rep3 = health.compare_ledgers(
+        a, _records([1.0, 0.8, 0.6, 0.5], events_at=2))
+    assert not rep3["ok"]
+    assert rep3["checks"]["final_loss"] and rep3["checks"]["trajectory"]
+    assert not rep3["checks"]["divergence"]
+    # no overlapping steps is a failure, not a vacuous pass
+    b = [{"step": 100 + i, "loss": 1.0} for i in range(3)]
+    assert not health.compare_ledgers(a, b)["ok"]
+
+
+def test_health_cli_rcs(tmp_path, capsys):
+    import json
+
+    from paddle_tpu.cli import main as cli_main
+
+    def write(name, records):
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+        return p
+
+    pa = write("a.jsonl", _records([1.0, 0.8, 0.6, 0.5]))
+    pb = write("b.jsonl", _records([1.0, 0.8, 0.6, 0.5]))
+    pc = write("c.jsonl", _records([1.0, 0.8, 0.9, 0.9]))
+    assert cli_main(["health", "summary", pa]) == 0
+    assert cli_main(["health", "compare", pa, pb]) == 0
+    assert cli_main(["health", "compare", pa, pc]) == 1
+    assert cli_main(["health", "compare", pa,
+                     str(tmp_path / "nope.jsonl")]) == 2
+    # a loose tolerance turns the numeric failure back into parity
+    assert cli_main(["health", "compare", pa, pc,
+                     "--tol-final", "10", "--tol-traj", "10"]) == 0
+    capsys.readouterr()
+
+
+# ------------------------------------------------- fused stats correctness
+
+
+def test_stats_match_numpy_single_device():
+    xs, ys = _data()
+
+    # reference run, health OFF: fetch the grads explicitly
+    main, startup, loss = _build_net()
+    params = [p.name for p in main.global_block().all_parameters()]
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w_old = {n: _var(scope, n) for n in params}
+        outs = exe.run(main, feed={"x": xs, "y": ys},
+                       fetch_list=[loss] + [n + "@GRAD" for n in params])
+        ref_loss = float(np.asarray(outs[0]).reshape(-1)[0])
+        grads = {n: np.asarray(g, np.float64)
+                 for n, g in zip(params, outs[1:])}
+        w_new = {n: _var(scope, n) for n in params}
+
+    # same seed, health ON: the fused stats must reproduce numpy
+    main2, startup2, loss2 = _build_net()
+    scope2 = fluid.Scope()
+    with flag_guard(health=1, health_interval=1), \
+            fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        out2 = exe.run(main2, feed={"x": xs, "y": ys}, fetch_list=[loss2])
+        rec = health.last_record()
+
+    assert rec is not None and rec["step"] == 0
+    assert rec["loss"] == pytest.approx(ref_loss, rel=1e-6)
+    assert set(rec["params"]) == set(params)
+    gsq_total = 0.0
+    for n in params:
+        st = rec["params"][n]
+        gn = np.linalg.norm(grads[n])
+        wn = np.linalg.norm(w_new[n])
+        dn = np.linalg.norm(w_new[n] - w_old[n])
+        np.testing.assert_allclose(st["grad_norm"], gn,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(st["weight_norm"], wn,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(st["update_ratio"],
+                                   dn / wn if wn > 0 else 0.0,
+                                   rtol=1e-4, atol=1e-6)
+        assert st["nonfinite"] == 0
+        gsq_total += gn * gn
+    np.testing.assert_allclose(rec["global_grad_norm"],
+                               np.sqrt(gsq_total), rtol=1e-5, atol=1e-5)
+    # health must not perturb the training math
+    assert float(np.asarray(out2[0]).reshape(-1)[0]) == \
+        pytest.approx(ref_loss, rel=1e-6)
+
+
+def test_interval_sampling_multi_step(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    xs, ys = _data()
+    K = 6
+    feeds = {"x": np.stack([xs] * K), "y": np.stack([ys] * K)}
+    main, startup, loss = _build_net()
+    scope = fluid.Scope()
+    with flag_guard(health=1, health_interval=3, health_ledger=path), \
+            fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed=feeds, fetch_list=[loss], iters=K)
+    health.reset()  # flush/close the writer before reading
+    records = health.read_ledger(path)
+    assert [r["step"] for r in records] == [0, 3]
+    assert all(r["kind"] == "executor" for r in records)
+
+
+def test_stats_parity_zero1_autoshard_8dev():
+    """Acceptance: per-param stats computed on shards under zero1 +
+    autoshard (dp=4 x mp=2) match the unsharded single-Executor numpy
+    reference — canonical param names, no regather."""
+    in_dim, hidden = 13, 16
+    rs = np.random.RandomState(0)
+    xs = rs.randn(32, in_dim).astype(np.float32)
+    ys = (xs @ rs.randn(in_dim, 1) + 0.3).astype(np.float32)
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[in_dim],
+                                  dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(input=x, size=hidden, act="relu")
+            p = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=p, label=y))
+            fluid.optimizer.Momentum(
+                learning_rate=0.05, momentum=0.9).minimize(loss)
+            main.random_seed = startup.random_seed = 7
+        return main, startup, loss
+
+    def run_exe(steps):
+        recs = []
+        main, startup, loss = build()
+        scope = fluid.Scope()
+        with flag_guard(health=1, health_interval=1), \
+                fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for _ in range(steps):
+                exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+                recs.append(health.last_record())
+        health.reset()
+        return recs
+
+    def run_pe(steps):
+        recs = []
+        main, startup, loss = build()
+        set_sharding(main.global_block().var("fc_0.w_0"), (None, "mp"))
+        scope = fluid.Scope()
+        with flag_guard(health=1, health_interval=1), \
+                fluid.scope_guard(scope):
+            fluid.Executor(fluid.CPUPlace()).run(startup)
+            bs = BuildStrategy()
+            bs.sharded_weight_update = True
+            bs.auto_sharding = True
+            pe = ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                  main_program=main, build_strategy=bs,
+                                  mesh_shape={"dp": 4, "mp": 2})
+            for _ in range(steps):
+                pe.run([loss], feed={"x": xs, "y": ys})
+                recs.append(health.last_record())
+        health.reset()
+        return recs
+
+    ref = run_exe(4)
+    got = run_pe(4)
+    assert len(ref) == len(got) == 4
+    for r_ref, r_got in zip(ref, got):
+        assert r_got["kind"] == "parallel_executor"
+        # zero1 suffixes stripped: same canonical param names
+        assert set(r_got["params"]) == set(r_ref["params"])
+        for n in sorted(r_ref["params"]):
+            a, b = r_ref["params"][n], r_got["params"][n]
+            for key in ("grad_norm", "weight_norm", "update_ratio"):
+                np.testing.assert_allclose(
+                    b[key], a[key], rtol=1e-4, atol=1e-5,
+                    err_msg=f"{n}.{key} @step {r_ref['step']}")
+            assert b["nonfinite"] == 0
+        np.testing.assert_allclose(
+            r_got["global_grad_norm"], r_ref["global_grad_norm"],
+            rtol=1e-4, atol=1e-5)
+
+
+def test_health_off_is_inert():
+    xs, ys = _data()
+    main, startup, loss = _build_net()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    assert health.last_record() is None
+    assert health.plan_if_enabled(main) is None
+
+
+# -------------------------------------------------------------- chaos drill
+
+
+def test_chaos_scales_records_and_fires_detectors(tmp_path):
+    from paddle_tpu.resilience import chaos
+
+    path = str(tmp_path / "spike.jsonl")
+    xs, ys = _data()
+    main, startup, loss = _build_net()
+    monkey = chaos.ChaosMonkey([
+        chaos.Fault("loss_spike", at=6, scale=1e4),
+        chaos.Fault("grad_explode", at=7, scale=1e6),
+    ])
+    scope = fluid.Scope()
+    with flag_guard(health=1, health_interval=1, health_ledger=path), \
+            fluid.scope_guard(scope):
+        chaos.install(monkey)
+        try:
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for _ in range(10):
+                exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        finally:
+            chaos.uninstall()
+    events = health.pending_events()
+    health.reset()
+    records = health.read_ledger(path)
+    by_step = {r["step"]: r for r in records}
+    assert "loss_spike" in by_step[6]["events"]
+    assert "grad_explode" in by_step[7]["events"]
+    # the poisoned values landed in the sampled record
+    assert by_step[6]["loss"] > 100 * abs(by_step[5]["loss"])
+    assert by_step[7]["global_grad_norm"] > \
+        100 * by_step[5]["global_grad_norm"]
+    # times=1 fired-cap: the faults do not re-fire — later losses/grads
+    # are back at normal scale (the EMA-based divergence detector may
+    # keep flagging while the poisoned EMA decays; that is by design)
+    assert abs(by_step[9]["loss"]) < 100 * abs(by_step[5]["loss"])
+    assert by_step[9]["global_grad_norm"] < \
+        100 * by_step[5]["global_grad_norm"]
+    assert "loss_spike" not in by_step[9]["events"]
+    assert "grad_explode" not in by_step[9]["events"]
+    assert {k for k, _ in events} >= {"loss_spike", "grad_explode"}
+
+
+# ------------------------------------------------------- resilience policy
+
+
+def test_health_policy_warn_default_and_skip():
+    from paddle_tpu.health import detectors
+    from paddle_tpu.resilience import ResilienceConfig
+    from paddle_tpu.resilience.loop import ResilientRunner
+
+    runner = ResilientRunner(ResilienceConfig(handle_signals=False))
+    detectors._fire("loss_spike", 3)
+    out = runner.after_step({"loss": 1.0})  # warn: observe, don't act
+    assert out == {"loss": 1.0}
+    assert runner.global_step == 1
+    assert health.pending_events() == []  # drained by the policy hook
+
+    runner2 = ResilientRunner(
+        ResilienceConfig(handle_signals=False, health_policy="skip"))
+    detectors._fire("grad_explode", 5)
+    runner2.after_step({"loss": 1.0})
+    assert runner2.state["health_skipped_steps"] == 1
+
+
+def test_health_policy_invalid_raises():
+    from paddle_tpu.health import detectors
+    from paddle_tpu.resilience import ResilienceConfig
+    from paddle_tpu.resilience.loop import ResilientRunner
+
+    runner = ResilientRunner(
+        ResilienceConfig(handle_signals=False, health_policy="bogus"))
+    detectors._fire("loss_spike", 0)
+    with pytest.raises(ValueError):
+        runner.after_step({"loss": 1.0})
+
+
+def test_health_policy_restore_rolls_back(tmp_path):
+    from paddle_tpu.health import detectors
+    from paddle_tpu.resilience import ResilienceConfig
+    from paddle_tpu.resilience.loop import ResilientRunner, RolledBack
+
+    main, startup, loss = _build_net()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        runner = ResilientRunner(
+            ResilienceConfig(checkpoint_dir=str(tmp_path),
+                             async_checkpoints=False,
+                             handle_signals=False,
+                             health_policy="restore"),
+            scope=scope, program=main, place=fluid.CPUPlace())
+        runner.save(block=True)
+        detectors._fire("loss_divergence", 0)
+        with pytest.raises(RolledBack):
+            runner.after_step({"loss": 2.0})

@@ -289,6 +289,14 @@ assert a["loss_parity_max_abs_diff"] <= 1e-4, a
 assert a["plan"]["total"], a
 assert a["plan"]["unresolved"] == 0, a
 assert a["plan"]["sharded_vars"] > 0, a
+# health overhead A/B: FLAGS_health=0 must stay one flag check (the same
+# <=1%/0.25ms gate as trace), and the warm enabled-at-interval-10 loop —
+# fused stat reductions in the step, readback skipped 9 of 10 steps —
+# within 3% / 0.75ms of the OFF baseline
+h = result.get("health")
+assert h is not None, result
+assert h["off_delta_ok"], h
+assert h["on_overhead_ok"], h
 print("bench --dry: ok")
 '
 if [ $? -ne 0 ]; then
@@ -315,6 +323,168 @@ if [ $? -ne 0 ]; then
     echo "GATE: AUTOSHARD MULTICHIP DRYRUN RED — do not commit" >&2
     exit 1
 fi
+
+# health run-parity: the same net trained with zero1 off and on (fused
+# health stats at interval=1) on the 8-device virtual mesh must produce
+# ledgers `health compare` certifies as parity (rc 0) — the sharded stat
+# reductions and the sharded update itself both have to agree with the
+# unsharded run for this to pass
+HEALTH_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+HEALTH_TMP="$HEALTH_TMP" python - <<'EOF'
+import os
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import flags
+from paddle_tpu.parallel_executor import BuildStrategy, ParallelExecutor
+import paddle_tpu.health as health
+
+tmp = os.environ["HEALTH_TMP"]
+
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=17, act="relu")
+        p = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.Momentum(
+            learning_rate=0.05, momentum=0.9).minimize(loss)
+        main.random_seed = startup.random_seed = 7
+    return main, startup, loss
+
+
+rs = np.random.RandomState(0)
+xs = rs.randn(64, 13).astype("float32")
+ys = (xs @ rs.randn(13, 1) + 0.3).astype("float32")
+
+
+def run(sharded, ledger):
+    health.reset()
+    flags.set("health", 1)
+    flags.set("health_interval", 1)
+    flags.set("health_ledger", ledger)
+    main, startup, loss = build()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        bs = BuildStrategy()
+        bs.sharded_weight_update = sharded
+        pe = ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                              main_program=main, build_strategy=bs)
+        for _ in range(8):
+            pe.run([loss], feed={"x": xs, "y": ys})
+    health.reset()
+    flags.set("health", 0)
+    flags.set("health_ledger", "")
+
+
+run(False, os.path.join(tmp, "off.jsonl"))
+run(True, os.path.join(tmp, "on.jsonl"))
+print("health parity ledgers written")
+EOF
+if [ $? -ne 0 ]; then
+    echo "GATE: HEALTH LEDGER SMOKE RED — do not commit" >&2
+    exit 1
+fi
+python -m paddle_tpu health compare \
+    "$HEALTH_TMP/off.jsonl" "$HEALTH_TMP/on.jsonl"
+if [ $? -ne 0 ]; then
+    echo "GATE: HEALTH ZERO1 PARITY RED — do not commit" >&2
+    exit 1
+fi
+python -m paddle_tpu health summary "$HEALTH_TMP/on.jsonl" > /dev/null
+if [ $? -ne 0 ]; then
+    echo "GATE: HEALTH SUMMARY RED — do not commit" >&2
+    exit 1
+fi
+
+# health detection drill: a chaos loss_spike run must fire the loss-spike
+# detector, leave a loadable flight-recorder dump
+# (trace_health_loss_spike_*/trace.json), and FAIL `health compare`
+# against the clean run (rc 1)
+JAX_PLATFORMS=cpu HEALTH_TMP="$HEALTH_TMP" python - <<'EOF'
+import glob
+import json
+import os
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import flags
+import paddle_tpu.health as health
+from paddle_tpu.resilience import chaos
+
+tmp = os.environ["HEALTH_TMP"]
+dumpdir = os.path.join(tmp, "dumps")
+
+
+def run(ledger, spike):
+    health.reset()
+    flags.set("health", 1)
+    flags.set("health_interval", 1)
+    flags.set("health_ledger", ledger)
+    if spike:
+        flags.set("trace", True)
+        flags.set("trace_dump_dir", dumpdir)
+        flags.set("trace_dump_cooldown_s", 0.0)
+        chaos.install(chaos.ChaosMonkey(
+            [chaos.Fault("loss_spike", at=6, scale=1e4)]))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        p = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        main.random_seed = startup.random_seed = 7
+    scope = fluid.Scope()
+    rs = np.random.RandomState(3)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(12):
+            xb = rs.randn(8, 4).astype(np.float32)
+            yb = (xb.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+    events = health.pending_events()
+    if spike:
+        chaos.uninstall()
+        flags.set("trace", False)
+        flags.set("trace_dump_cooldown_s", 60.0)
+        flags.set("trace_dump_dir", "")
+    health.reset()
+    flags.set("health", 0)
+    flags.set("health_ledger", "")
+    return events
+
+
+run(os.path.join(tmp, "clean.jsonl"), spike=False)
+events = run(os.path.join(tmp, "spike.jsonl"), spike=True)
+assert any(kind == "loss_spike" for kind, _ in events), events
+dumps = glob.glob(os.path.join(dumpdir, "trace_health_loss_spike_*"))
+assert dumps, (dumpdir, os.listdir(dumpdir)
+               if os.path.isdir(dumpdir) else "missing")
+with open(os.path.join(dumps[0], "trace.json")) as f:
+    json.load(f)
+print("health chaos drill: detector fired, dump loads")
+EOF
+if [ $? -ne 0 ]; then
+    echo "GATE: HEALTH CHAOS DRILL RED — do not commit" >&2
+    exit 1
+fi
+python -m paddle_tpu health compare \
+    "$HEALTH_TMP/clean.jsonl" "$HEALTH_TMP/spike.jsonl"
+if [ $? -eq 1 ]; then
+    echo "health compare flags the spiked run: ok"
+else
+    echo "GATE: HEALTH SPIKE COMPARE RED (expected rc 1) — do not commit" >&2
+    exit 1
+fi
+rm -rf "$HEALTH_TMP"
 
 # shard plan CLI: the self-contained planner demo must resolve a total
 # plan and exit 0 (exercises the seed-validation + render path end to end)
