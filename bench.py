@@ -865,6 +865,86 @@ def _zero1_ab(fluid):
     return out
 
 
+def _overlap_ab(fluid):
+    """Static overlap schedule A/B on the dp mesh (analysis/schedule.py):
+    the same momentum net trained through the zero1 ParallelExecutor path
+    with FLAGS_overlap_plan off and on. The plan only permutes ops along
+    existing dependency edges, so loss parity must be BITWISE (0.0); the
+    step-time delta must stay within noise (the reorder is semantically
+    free — on TPU it buys reduce-scatter/compute overlap, on the CPU A/B
+    it must at least cost nothing). Needs >=2 devices."""
+    import jax
+    from paddle_tpu import flags as _flags
+    from paddle_tpu.parallel_executor import BuildStrategy, ParallelExecutor
+
+    n = len(jax.devices())
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(input=x, size=256, act="relu")
+            h = fluid.layers.fc(input=h, size=256, act="relu")
+            p = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=p, label=y))
+            fluid.optimizer.Momentum(
+                learning_rate=0.01, momentum=0.9).minimize(loss)
+            main.random_seed = startup.random_seed = 11
+        return main, startup, loss
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(8 * n, 64).astype(np.float32)
+    ys = rs.randn(8 * n, 1).astype(np.float32)
+
+    out, losses = {"dp": n}, {}
+    for overlap in (False, True):
+        main, startup, loss = build()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope), \
+                _flags.flag_guard(overlap_plan=overlap):
+            fluid.Executor(fluid.CPUPlace()).run(startup)
+            bs = BuildStrategy()
+            bs.sharded_weight_update = True
+            pe = ParallelExecutor(use_cuda=False, main_program=main,
+                                  build_strategy=bs)
+            seq = []
+            for _ in range(5):  # first call compiles; all steps train
+                lv, = pe.run([loss], feed={"x": xs, "y": ys})
+                seq.append(float(np.asarray(lv).reshape(-1)[0]))
+            timed = 10
+            t0 = time.perf_counter()
+            for _ in range(timed):
+                lv, = pe.run([loss], feed={"x": xs, "y": ys})
+            np.asarray(lv)  # fence the last dispatch
+            ms = (time.perf_counter() - t0) * 1000.0 / timed
+            sched = next(iter(pe._overlap_cache.values()))[1] \
+                if pe._overlap_cache else None
+        key = "on" if overlap else "off"
+        losses[key] = seq
+        out[key] = {"step_ms": round(ms, 3)}
+        if sched is not None:
+            out["plan"] = {
+                "critical_path_ms": sched.critical_path_ms,
+                "serial_ms": sched.serial_ms,
+                "hoistable_bytes": sched.plan.hoistable_bytes,
+                "buckets": len(sched.plan.buckets),
+                "moves": len(sched.plan.moves),
+                "digest": sched.plan.digest(),
+            }
+    out["loss_curves"] = losses
+    out["loss_parity_max_abs_diff"] = float(max(
+        abs(a - b) for a, b in zip(losses["on"], losses["off"])))
+    on_ms, off_ms = out["on"]["step_ms"], out["off"]["step_ms"]
+    delta = (on_ms - off_ms) / max(off_ms, 1e-9)
+    out["on_delta_frac"] = round(delta, 4)
+    # within 1% — or within an absolute 0.25 ms floor, CPU timer jitter
+    # dominates at these step times
+    out["on_delta_ok"] = delta <= 0.01 or abs(on_ms - off_ms) <= 0.25
+    return out
+
+
 def _autoshard_ab(fluid):
     """Autoshard vs hand-annotated A/B on the dp x mp mesh
     (parallel/autoshard): an embedding+fc net with seed annotations on
@@ -1003,6 +1083,33 @@ def measure_dry_zero1(fluid):
     if proc.returncode != 0:
         raise RuntimeError(
             f"zero1 dry subprocess failed (rc={proc.returncode}): "
+            f"{proc.stderr[-500:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def measure_dry_overlap(fluid):
+    """bench.py --dry overlap block. The A/B needs a dp mesh for the
+    zero1 path the plan reorders, so with one local device re-exec onto
+    an 8-device virtual CPU mesh and relay the child's JSON."""
+    import jax
+
+    if len(jax.devices()) >= 2:
+        return _overlap_ab(fluid)
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    parts = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    parts.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(parts)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--overlap-dry"],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"overlap dry subprocess failed (rc={proc.returncode}): "
             f"{proc.stderr[-500:]}")
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
@@ -1181,6 +1288,12 @@ def measure_dry(fluid):
         result["autoshard"] = measure_dry_autoshard(fluid)
     except Exception as e:
         result["autoshard_error"] = f"{type(e).__name__}: {e}"
+    # overlap-schedule A/B (FLAGS_overlap_plan): bitwise loss parity and
+    # a warm-step time delta within noise for the reordered zero1 program
+    try:
+        result["overlap"] = measure_dry_overlap(fluid)
+    except Exception as e:
+        result["overlap_error"] = f"{type(e).__name__}: {e}"
     # serving mode, CI-sized: the same A/B the full --serve run does
     # (unbatched vs Server QPS, percentiles, zero-steady-compile check);
     # runs AFTER the cache snapshot above because it resets the monitor
@@ -1301,6 +1414,11 @@ def main():
     if "--autoshard-dry" in sys.argv:
         # child mode of measure_dry_autoshard (8-device virtual CPU mesh)
         print(json.dumps(_autoshard_ab(fluid)))
+        return
+
+    if "--overlap-dry" in sys.argv:
+        # child mode of measure_dry_overlap (8-device virtual CPU mesh)
+        print(json.dumps(_overlap_ab(fluid)))
         return
 
     if "--serve" in sys.argv:

@@ -1,6 +1,6 @@
 """paddle_tpu.analysis — static ProgramDesc verification.
 
-Four layers of checks over the program-as-IR (see docs/analysis.md for
+Five layers of checks over the program-as-IR (see docs/analysis.md for
 the full catalog with error codes):
 
   * structural graph verification (def-before-use with sub-block scoping,
@@ -8,6 +8,9 @@ the full catalog with error codes):
     pairing) — the `basic` level;
   * safety analyses (donated-buffer read-after-donate, write-after-read
     from in-place rewiring, cross-replica collective order) — `full`;
+  * SSA dataflow-graph hazards (cycles, versioned WAR/WAW, collective
+    dependence through sub-blocks, donation-aliasing races — PTA03x,
+    `dataflow`) plus the static overlap scheduler (`schedule`) — `full`;
   * sharding/plan validation (mesh axes, divisibility, reshard audit) —
     `full`, when a mesh or plan is in scope;
   * a liveness-based peak-HBM estimate per replica — `full`, exported as
@@ -23,14 +26,17 @@ of an enabled flag is zero and of the flag itself one check.
 from .. import flags
 from .diagnostics import (CATALOG, Diagnostic, ProgramVerificationError,
                           Report, Severity)
+from . import dataflow
 from . import plans as _plans
 from . import safety as _safety
+from . import schedule
 from . import verifier as _verifier
 from .hbm import estimate_peak_hbm, measured_live_bytes
 
 __all__ = ["verify", "ensure_verified", "reset", "LEVELS",
            "Diagnostic", "Report", "Severity", "ProgramVerificationError",
-           "CATALOG", "estimate_peak_hbm", "measured_live_bytes"]
+           "CATALOG", "estimate_peak_hbm", "measured_live_bytes",
+           "dataflow", "schedule"]
 
 flags.define(
     "verify", str, "off",
@@ -63,6 +69,8 @@ def verify(program, level="basic", feed_names=None, fetch_names=None,
         _safety.check_donation(program, report, donate_state=donate_state)
         _safety.check_war_hazards(program, report)
         _safety.check_collective_order(program, report)
+        dataflow.check_hazards(program, report, feed_names=feed_names,
+                               donate_state=donate_state)
         _plans.check_var_sharding(program, mesh_axes, report)
         _plans.check_autoshard_plan(aplan, report)
         _plans.check_zero1_plan(zplan, program, report,

@@ -9,6 +9,8 @@ Code ranges:
   PTA001-PTA009  structural (graph well-formedness, shape contracts)
   PTA010-PTA019  safety (donation, write-after-read, collective order)
   PTA020-PTA029  sharding/plan validation (mesh axes, divisibility, audit)
+  PTA030-PTA039  dataflow-graph hazards (SSA def-use analysis; the checks
+                 that make static reordering/overlap scheduling safe)
 """
 
 __all__ = ["Severity", "Diagnostic", "Report", "ProgramVerificationError",
@@ -60,6 +62,22 @@ CATALOG = {
                "autoshard plan is not total (unresolved/unassigned vars)"),
     "PTA023": (Severity.WARNING,
                "reshard-edge audit mismatch"),
+    # -- dataflow-graph hazards (analysis.dataflow) -------------------------
+    "PTA030": (Severity.ERROR,
+               "cyclic def-use dependency: no execution order satisfies "
+               "the graph"),
+    "PTA031": (Severity.ERROR,
+               "WAR hazard (SSA): grad op reads a later variable version "
+               "than its paired forward op consumed"),
+    "PTA032": (Severity.ERROR,
+               "WAW hazard: persistable written more than once per step "
+               "(lost update under buffer donation)"),
+    "PTA033": (Severity.ERROR,
+               "collective-order divergence: zero1 group member not "
+               "linked to its update by a dependency path"),
+    "PTA034": (Severity.ERROR,
+               "donation-aliasing race: stale view of a donated buffer "
+               "read after the root's update"),
 }
 
 
@@ -129,6 +147,16 @@ class Report:
     def add(self, code, message, **loc):
         self.diagnostics.append(Diagnostic(code, message, **loc))
 
+    def sorted_diagnostics(self):
+        """Diagnostics in (block, op index, code) order — check order is
+        an implementation detail, so render()/to_dict() sort to keep
+        `check --json` output and green_gate diffs deterministic."""
+        return sorted(
+            self.diagnostics,
+            key=lambda d: (d.block_idx if d.block_idx is not None else -1,
+                           d.op_idx if d.op_idx is not None else -1,
+                           d.code, d.var or "", d.message))
+
     def errors(self):
         return [d for d in self.diagnostics if d.severity == Severity.ERROR]
 
@@ -156,7 +184,7 @@ class Report:
             "n_errors": len(self.errors()),
             "n_warnings": len(self.warnings()),
             "summary": dict(self.summary),
-            "diagnostics": [d.to_dict() for d in self.diagnostics],
+            "diagnostics": [d.to_dict() for d in self.sorted_diagnostics()],
             "hbm": self.hbm,
         }
 
@@ -168,7 +196,9 @@ class Report:
                 f"{len(self.errors())} error(s), "
                 f"{len(self.warnings())} warning(s)")
         lines = [head]
-        shown = self.diagnostics if verbose else self.errors()
+        shown = self.sorted_diagnostics()
+        if not verbose:
+            shown = [d for d in shown if d.severity == Severity.ERROR]
         lines += [f"  {d}" for d in shown]
         if self.hbm:
             from .hbm import render_table

@@ -27,14 +27,40 @@ have its regathering collective.
 """
 
 from ..core.framework import OpRole
-from .verifier import COLLECTIVE_OPS, op_role, sub_blocks
+from .verifier import COLLECTIVE_OPS, op_role, sub_blocks, written_names
 
 __all__ = ["check_donation", "check_war_hazards",
            "check_collective_order"]
 
 
+def _sub_reads_after_update(block, pos, updated_at, report, sev_note):
+    """PTA010 inside a sub-block tree: the whole tree executes at program
+    point `pos` (the parent op's block-0 index), so a read of a
+    persistable updated before `pos` is the same stale-donated-buffer
+    observation the top-level scan flags."""
+    for i, op in enumerate(block.ops):
+        role = op_role(op)
+        if role not in (OpRole.Optimize, OpRole.RPC) \
+                and op.type not in COLLECTIVE_OPS:
+            for name in op.input_arg_names():
+                j = updated_at.get(name)
+                if j is not None and j < pos:
+                    report.add(
+                        "PTA010",
+                        f"op inside a control-flow sub-block (entered at "
+                        f"top-level op#{pos}) reads persistable {name!r} "
+                        f"after its weight update at op#{j} "
+                        f"donated/overwrote the buffer{sev_note}",
+                        block_idx=block.idx, op_idx=i, op_type=op.type,
+                        var=name)
+        for sb in sub_blocks(op):
+            _sub_reads_after_update(sb, pos, updated_at, report, sev_note)
+
+
 def check_donation(program, report, donate_state=True):
-    """PTA010 over block 0 (optimizer ops never sit in sub-blocks)."""
+    """PTA010 over block 0 AND control-flow sub-blocks (updates land in
+    block 0 — optimizer ops never sit in sub-blocks — but a while/cond
+    body placed after the update can still read the donated param)."""
     ops = program.global_block().ops
     gb = program.global_block()
     # program point where each persistable's update lands: outputs of
@@ -53,30 +79,42 @@ def check_donation(program, report, donate_state=True):
         " (donate_state is off here, but the stale-read remains)"
     for i, op in enumerate(ops):
         role = op_role(op)
-        if role in (OpRole.Optimize, OpRole.RPC):
-            continue
-        if op.type in COLLECTIVE_OPS:
-            continue  # zero1's own scatter/gather plumbing
-        for name in op.input_arg_names():
-            j = updated_at.get(name)
-            if j is not None and j < i:
-                report.add(
-                    "PTA010",
-                    f"{'forward' if role == OpRole.Forward else 'backward'}"
-                    f"-role op reads persistable {name!r} after its weight "
-                    f"update at op#{j} donated/overwrote the buffer"
-                    f"{sev_note}",
-                    block_idx=0, op_idx=i, op_type=op.type, var=name)
+        if role not in (OpRole.Optimize, OpRole.RPC) \
+                and op.type not in COLLECTIVE_OPS:
+            for name in op.input_arg_names():
+                j = updated_at.get(name)
+                if j is not None and j < i:
+                    report.add(
+                        "PTA010",
+                        f"{'forward' if role == OpRole.Forward else 'backward'}"
+                        f"-role op reads persistable {name!r} after its "
+                        f"weight update at op#{j} donated/overwrote the "
+                        f"buffer{sev_note}",
+                        block_idx=0, op_idx=i, op_type=op.type, var=name)
+        for sb in sub_blocks(op):
+            _sub_reads_after_update(sb, i, updated_at, report, sev_note)
 
 
 def check_war_hazards(program, report):
-    """PTA011 over block 0: a grad op reading a forward value that was
-    overwritten after the paired forward op consumed it."""
-    ops = program.global_block().ops
+    """PTA011 over block 0, with sub-block writes folded in: a grad op
+    reading a forward value that was overwritten after the paired forward
+    op consumed it.  An op carrying a sub-block (while/cond) counts as
+    writing, at its own index, every name its body writes into the parent
+    scope — so an in-body overwrite of a forward activation is visible to
+    the flat scan."""
+    gb = program.global_block()
+    ops = gb.ops
     writers = {}  # name -> [op indices that write it]
     for i, op in enumerate(ops):
         for name in op.output_arg_names():
             writers.setdefault(name, []).append(i)
+        for sb in sub_blocks(op):
+            for name in written_names(sb):
+                # only names resolving in the parent scope escape
+                if name not in sb.vars and gb.has_var_recursive(name):
+                    ws = writers.setdefault(name, [])
+                    if not ws or ws[-1] != i:
+                        ws.append(i)
     for k, g in enumerate(ops):
         if op_role(g) != OpRole.Backward or not g.type.endswith("_grad"):
             continue
@@ -129,28 +167,38 @@ def check_collective_order(program, report):
                 f"on some replicas and deadlock the others",
                 block_idx=bidx, op_idx=i, op_type=op.type)
 
-    # zero1 group invariants on block 0: for every param with shard-layout
-    # plumbing, order must be scatter(grad) < update < gather, and the
-    # gather must exist and consume the update's output.
-    ops = program.global_block().ops
+    # zero1 group invariants: for every param with shard-layout plumbing,
+    # order must be scatter(grad) < update < gather, and the gather must
+    # exist and consume the update's output.  Group members are collected
+    # through sub-blocks too (a nested member executes at its top-level
+    # op's program point — PTA013 flags the nesting itself separately, but
+    # the group-completeness invariants still apply).
     groups = {}  # param name -> dict of indices
-    for i, op in enumerate(ops):
-        if op.type == "zero1_scatter":
-            out = (op.outputs.get("Out") or [""])[0]
-            if out.endswith("@zero1_rs"):
-                groups.setdefault(out[:-len("@zero1_rs")], {})["rs"] = i
-            elif out.endswith("@zero1_shard"):
-                groups.setdefault(
-                    out[:-len("@zero1_shard")], {})["pshard"] = i
-        elif op.type == "zero1_gather":
-            out = (op.outputs.get("Out") or [""])[0]
-            if out:
-                groups.setdefault(out, {})["gather"] = i
-        else:
-            for name in op.output_arg_names():
-                if name.endswith("@zero1_upd"):
+
+    def _scan(block, pos=None):
+        for i, op in enumerate(block.ops):
+            p = i if pos is None else pos
+            if op.type == "zero1_scatter":
+                out = (op.outputs.get("Out") or [""])[0]
+                if out.endswith("@zero1_rs"):
                     groups.setdefault(
-                        name[:-len("@zero1_upd")], {})["upd"] = i
+                        out[:-len("@zero1_rs")], {})["rs"] = p
+                elif out.endswith("@zero1_shard"):
+                    groups.setdefault(
+                        out[:-len("@zero1_shard")], {})["pshard"] = p
+            elif op.type == "zero1_gather":
+                out = (op.outputs.get("Out") or [""])[0]
+                if out:
+                    groups.setdefault(out, {})["gather"] = p
+            else:
+                for name in op.output_arg_names():
+                    if name.endswith("@zero1_upd"):
+                        groups.setdefault(
+                            name[:-len("@zero1_upd")], {})["upd"] = p
+            for sb in sub_blocks(op):
+                _scan(sb, p)
+
+    _scan(program.global_block())
     # `groups` keys mix grad and param names; a param group is one with an
     # update or gather or param-shard scatter
     for key, g in sorted(groups.items()):

@@ -383,6 +383,187 @@ def _cmd_check(args):
     return report.rc
 
 
+def _load_saved_program(model_dir):
+    """(program, feed_names, fetch_names) from a save_inference_model dir,
+    or an error string."""
+    import json
+
+    from .core.framework import Program
+
+    model_path = os.path.join(model_dir, "__model__")
+    try:
+        with open(model_path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        return f"cannot load {model_path}: {e}"
+    return (Program.from_dict(payload["program"]),
+            payload.get("feed_var_names"),
+            payload.get("fetch_var_names"))
+
+
+def _seed_cycle(program):
+    """Clone with a genuine def-use cycle appended (two scale ops reading
+    each other's outputs) — the `analyze graph --selftest` mutation."""
+    from .core.framework import OP_ROLE_ATTR_NAME, OpRole
+
+    clone = program.clone()
+    gb = clone.global_block()
+    for nm in ("a_cyc", "b_cyc"):
+        gb.create_var(name=nm, shape=[1], dtype="float32")
+    role = {OP_ROLE_ATTR_NAME: int(OpRole.Forward), "scale": 1.0}
+    gb.append_op(type="scale", inputs={"X": ["b_cyc"]},
+                 outputs={"Out": ["a_cyc"]}, attrs=dict(role))
+    gb.append_op(type="scale", inputs={"X": ["a_cyc"]},
+                 outputs={"Out": ["b_cyc"]}, attrs=dict(role))
+    return clone
+
+
+def _seed_gather_rewire(program):
+    """Clone of a zero1-rewritten program whose first zero1_gather is
+    rewired to consume the PRE-update param shard — flat index order stays
+    valid (PTA012-clean) but the gather no longer consumes the update, the
+    dependence-path divergence only PTA033 sees."""
+    clone = program.clone()
+    gb = clone.global_block()
+    gat = next(op for op in gb.ops if op.type == "zero1_gather")
+    pupd = gat.input("X")[0]
+    gat.rename_input(pupd, pupd.replace("@zero1_upd", "@zero1_shard"))
+    clone._mutation += 1
+    return clone
+
+
+def _cmd_analyze(args):
+    import json
+
+    from .analysis import (ProgramVerificationError, Report, dataflow,
+                           schedule)
+
+    mesh_axes = None
+    if getattr(args, "mesh", None):
+        mesh_axes = {}
+        for part in args.mesh.split(","):
+            if not part.strip():
+                continue
+            k, _, v = part.partition("=")
+            try:
+                mesh_axes[k.strip()] = int(v)
+            except ValueError:
+                print(f"bad --mesh entry {part!r} (want name=size)",
+                      file=sys.stderr)
+                return 2
+
+    def _resolve_program():
+        """(program, feeds) for the non-selftest path, honoring --zero1."""
+        if not args.model_dir:
+            print(f"analyze {args.analyze_action} needs --model-dir or "
+                  f"--selftest", file=sys.stderr)
+            return None
+        loaded = _load_saved_program(args.model_dir)
+        if isinstance(loaded, str):
+            print(loaded, file=sys.stderr)
+            return None
+        program, feeds, _ = loaded
+        if args.zero1:
+            from .parallel import zero1 as _z1
+            program, _ = _z1.apply(program, args.zero1)
+        return program, feeds
+
+    if args.analyze_action == "graph":
+        if args.selftest:
+            prog, feeds, _ = _check_demo_program()
+            if args.zero1:
+                from .parallel import zero1 as _z1
+                prog, _ = _z1.apply(prog, args.zero1)
+            graph = dataflow.build_graph(prog, feed_names=feeds)
+            clean = Report(level="full", context="analyze graph --selftest")
+            dataflow.check_hazards(prog, clean, feed_names=feeds,
+                                   graph=graph)
+            seeded = Report(level="full",
+                            context="analyze graph --selftest (cyclic)")
+            dataflow.check_hazards(_seed_cycle(prog), seeded,
+                                   feed_names=feeds)
+            ok = clean.ok and not graph.has_cycle \
+                and not seeded.ok and "PTA030" in seeded.codes()
+            if args.json:
+                print(json.dumps({"ok": ok, "graph": graph.summary(),
+                                  "clean": clean.to_dict(),
+                                  "seeded": seeded.to_dict()}, indent=2))
+            else:
+                print(f"graph: {graph.summary()}")
+                print(clean.render(verbose=not args.quiet))
+                print("--- seeded cyclic clone (must flag PTA030) ---")
+                print(seeded.render(verbose=not args.quiet))
+                print(f"analyze graph selftest: {'OK' if ok else 'FAILED'}")
+            return 0 if ok else 1
+        resolved = _resolve_program()
+        if resolved is None:
+            return 2
+        program, feeds = resolved
+        report = Report(level="full",
+                        context=f"analyze graph {args.model_dir}")
+        graph = dataflow.check_hazards(program, report, feed_names=feeds)
+        if args.json:
+            print(json.dumps({"graph": graph.summary(),
+                              "report": report.to_dict()}, indent=2))
+        else:
+            print(f"graph: {graph.summary()}")
+            print(report.render(verbose=not args.quiet))
+        return report.rc
+
+    # analyze schedule
+    if args.selftest:
+        from .parallel import zero1 as _z1
+        prog, feeds, _ = _check_demo_program()
+        parts = args.zero1 or (mesh_axes or {}).get("dp", 8)
+        z, _zplan = _z1.apply(prog, parts)
+        sched = schedule.analyze(
+            z, mesh_axes=mesh_axes or {"dp": parts}, feed_names=feeds,
+            batch_size=args.batch, bucket_bytes=args.bucket_bytes)
+        reordered, plan = schedule.apply_plan(z, sched.plan,
+                                              feed_names=feeds)
+        ok = sched.critical_path_ms > 0 and len(plan.buckets) > 0 \
+            and len(plan.moves) > 0 and reordered is not z
+        # the seeded divergence must be REJECTED, never silently scheduled
+        rejected = False
+        codes = []
+        try:
+            schedule.analyze(_seed_gather_rewire(z),
+                             mesh_axes=mesh_axes or {"dp": parts},
+                             feed_names=feeds)
+        except ProgramVerificationError as e:
+            rejected = True
+            codes = sorted(e.report.codes())
+        ok = ok and rejected and "PTA033" in codes
+        if args.json:
+            print(json.dumps({"ok": ok, "schedule": sched.to_dict(),
+                              "seeded_rejected": rejected,
+                              "seeded_codes": codes}, indent=2))
+        else:
+            print(sched.render())
+            print(f"--- seeded gather-rewire clone: "
+                  f"{'rejected ' + str(codes) if rejected else 'NOT rejected'}"
+                  f" ---")
+            print(f"analyze schedule selftest: {'OK' if ok else 'FAILED'}")
+        return 0 if ok else 1
+
+    resolved = _resolve_program()
+    if resolved is None:
+        return 2
+    program, feeds = resolved
+    try:
+        sched = schedule.analyze(
+            program, mesh_axes=mesh_axes, feed_names=feeds,
+            batch_size=args.batch, bucket_bytes=args.bucket_bytes)
+    except ProgramVerificationError as e:
+        print(e.report.render(verbose=not args.quiet), file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(sched.to_dict(), indent=2))
+    else:
+        print(sched.render())
+    return 0
+
+
 def _cmd_serve(args):
     import json
 
@@ -789,6 +970,52 @@ def main(argv=None):
     ck.add_argument("--quiet", action="store_true",
                     help="show errors only, not warnings")
 
+    an = sub.add_parser("analyze", help="SSA dataflow graph, PTA03x hazard "
+                                        "detection, and the static overlap "
+                                        "schedule (docs/analysis.md)")
+    ansub = an.add_subparsers(dest="analyze_action", required=True)
+    ag = ansub.add_parser("graph", help="build the SSA def-use dependency "
+                                        "graph and run the dataflow hazard "
+                                        "detector (PTA030-PTA034)")
+    ag.add_argument("--model-dir", default=None,
+                    help="save_inference_model directory to analyze")
+    ag.add_argument("--zero1", type=int, default=0, metavar="N",
+                    help="apply the ZeRO-1 rewrite with N shards before "
+                         "analyzing")
+    ag.add_argument("--selftest", action="store_true",
+                    help="analyze a clean demo training program AND a "
+                         "seeded cyclic clone (must flag PTA030); rc 0 "
+                         "when both behave")
+    ag.add_argument("--json", action="store_true",
+                    help="emit the graph summary and report as JSON")
+    ag.add_argument("--quiet", action="store_true",
+                    help="show errors only, not warnings")
+    asch = ansub.add_parser(
+        "schedule", help="critical path over the analytic cost models and "
+                         "the bucketed reduce-scatter overlap plan")
+    asch.add_argument("--model-dir", default=None,
+                      help="save_inference_model directory to schedule")
+    asch.add_argument("--mesh", default="dp=8", metavar="NAME=SIZE,...",
+                      help="mesh axes for the ring collective-bytes model")
+    asch.add_argument("--zero1", type=int, default=0, metavar="N",
+                      help="apply the ZeRO-1 rewrite with N shards before "
+                           "scheduling")
+    asch.add_argument("--batch", type=int, default=1,
+                      help="batch size substituted for dynamic dims in the "
+                           "FLOPs model")
+    asch.add_argument("--bucket-bytes", type=int, default=None,
+                      help="override FLAGS_overlap_bucket_bytes for the "
+                           "gradient-bucketing plan")
+    asch.add_argument("--selftest", action="store_true",
+                      help="schedule a zero1-rewritten demo program (must "
+                           "hoist a non-empty bucket plan) AND verify a "
+                           "seeded collective-order divergence is rejected "
+                           "with PTA033; rc 0 when both behave")
+    asch.add_argument("--json", action="store_true",
+                      help="emit the schedule report as JSON")
+    asch.add_argument("--quiet", action="store_true",
+                      help="show errors only, not warnings")
+
     s = sub.add_parser("serve", help="serve a saved inference model with "
                                      "the batching engine")
     s.add_argument("--model-dir", required=True,
@@ -913,6 +1140,8 @@ def main(argv=None):
             return _cmd_shard(args)
         if args.command == "check":
             return _cmd_check(args)
+        if args.command == "analyze":
+            return _cmd_analyze(args)
         if args.command == "serve":
             return _cmd_serve(args)
         if args.command == "trace":

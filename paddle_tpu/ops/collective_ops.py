@@ -18,6 +18,37 @@ import jax.numpy as jnp
 from ..core.registry import register_op
 from .util import first, out
 
+# Declared read/write/alias sets per collective op type, consumed by the
+# static analyses (analysis.dataflow). Until now these were implicit in the
+# kernel bodies; the dataflow graph needs them explicit:
+#   reads/writes — input/output slots that carry the dataflow (all of these
+#     ops are pure slot-to-slot, but declaring it lets the analysis reject
+#     an op type it does not know instead of guessing);
+#   aliases — output slot -> input slot pairs where Out is a VIEW of the
+#     input buffer (pad/reshape/slice lineage, no fresh storage under XLA
+#     donation): reading the view after the root buffer was donated and
+#     overwritten is the PTA034 race;
+#   pending — attr naming the mesh axis whose reduction/gather is still in
+#     flight inside the value (ring-cost accounting in analysis.schedule).
+COLLECTIVE_RW = {
+    "all_reduce":         {"reads": ("X",), "writes": ("Out",),
+                           "aliases": {}, "pending": "axis_name"},
+    "all_gather":         {"reads": ("X",), "writes": ("Out",),
+                           "aliases": {}, "pending": "axis_name"},
+    "reduce_scatter":     {"reads": ("X",), "writes": ("Out",),
+                           "aliases": {}, "pending": "axis_name"},
+    "broadcast":          {"reads": ("X",), "writes": ("Out",),
+                           "aliases": {}, "pending": "axis_name"},
+    "collective_permute": {"reads": ("X",), "writes": ("Out",),
+                           "aliases": {}, "pending": "axis_name"},
+    # zero1 plumbing: Out is ravel+pad+reshape (scatter) / slice+reshape
+    # (gather) of X — a view of the same storage lineage, not a copy.
+    "zero1_scatter":      {"reads": ("X",), "writes": ("Out",),
+                           "aliases": {"Out": "X"}, "pending": "axis_name"},
+    "zero1_gather":       {"reads": ("X",), "writes": ("Out",),
+                           "aliases": {"Out": "X"}, "pending": "axis_name"},
+}
+
 
 def _in_mapped_axis(axis_name):
     try:

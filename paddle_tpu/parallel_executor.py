@@ -140,6 +140,10 @@ class ParallelExecutor:
         self._rewrite_cache = {}
         # autoshard ShardingPlans, keyed on (program identity, mutation)
         self._autoshard_cache = {}
+        # overlap-scheduled (reordered) clones of the resolved program +
+        # their ScheduleReport, keyed on (program identity, mutation,
+        # bucket bytes); strong refs keep id() stable for the compile cache
+        self._overlap_cache = {}
         self._step = 0
         self.num_trainers = num_trainers
         self.trainer_id = trainer_id
@@ -188,6 +192,29 @@ class ParallelExecutor:
                     program, plan, float(dp_n))
         self._rewrite_cache[key] = (run_program, plan)
         return run_program, plan
+
+    def _overlap_program(self, program, feed_names=None):
+        """Apply the static overlap schedule (analysis.schedule) to the
+        RESOLVED program: hoist the legal zero1_scatter reduce-scatters
+        into the backward section, bucketed under
+        FLAGS_overlap_bucket_bytes. Returns (program', ScheduleReport);
+        cached per (program identity, mutation, bucket bytes). A program
+        carrying any PTA03x dataflow hazard raises
+        ProgramVerificationError — it is never silently reordered."""
+        key = (id(program), program._mutation,
+               int(flags.get("overlap_bucket_bytes")))
+        hit = self._overlap_cache.get(key)
+        if hit is None:
+            sched = analysis.schedule.analyze(
+                program,
+                mesh_axes={str(k): int(v)
+                           for k, v in self._mesh.shape.items()},
+                feed_names=feed_names)
+            reordered, _ = analysis.schedule.apply_plan(
+                program, sched.plan, feed_names=feed_names)
+            hit = (reordered, sched)
+            self._overlap_cache[key] = hit
+        return hit
 
     def _autoshard_plan(self, program):
         """Total ShardingPlan for the RESOLVED program (zero1-rewritten when
@@ -324,6 +351,16 @@ class ParallelExecutor:
         # placement) runs against the resolved program — the zero1 rewrite
         # when sharding is on, else the original (plus One-scale ops)
         program, zplan = self._prepare_program(program, use_zero1, gss, dp_n)
+        # static overlap schedule (FLAGS_overlap_plan): reorder the zero1-
+        # rewritten program so grad reduce-scatters overlap the backward
+        # pass. Hazard-checked, cached, and compile-cache-keyed below.
+        use_overlap = bool(flags.get("overlap_plan")) and use_zero1 \
+            and bool(zplan.entries)
+        osched = None
+        if use_overlap:
+            program, osched = self._overlap_program(
+                program,
+                feed_names=list(feed) if isinstance(feed, dict) else None)
         use_autoshard = bs.auto_sharding
         if use_autoshard is None:
             use_autoshard = bool(flags.get("autoshard"))
@@ -370,6 +407,18 @@ class ParallelExecutor:
                 k: int(v) for k, v in cb.items()}
             mon.extra["optimizer_state_bytes"] = int(osb)
             mon.extra["zero1"] = bool(use_zero1)
+        if mon is not None and osched is not None:
+            analysis.schedule.record_gauges(
+                osched, context="parallel_executor")
+            if mon.extra is None:
+                mon.extra = {}
+            mon.extra["overlap"] = {
+                "critical_path_ms": float(osched.critical_path_ms),
+                "hoistable_bytes": int(osched.plan.hoistable_bytes),
+                "buckets": len(osched.plan.buckets),
+                "moves": len(osched.plan.moves),
+                "digest": osched.plan.digest(),
+            }
         if mon is not None and aplan is not None:
             reg = monitor.registry()
             reg.gauge(
@@ -441,6 +490,8 @@ class ParallelExecutor:
             ("wire", wire.fingerprint() if wire is not None else None),
             ("donate_feeds", donate_feeds),
             ("zero1", use_zero1, gss, dp_n),
+            ("overlap",
+             osched.plan.digest() if osched is not None else None),
             ("autoshard", aplan.digest() if aplan is not None else None),
             ("health", hplan.digest if hplan is not None else None),
         )

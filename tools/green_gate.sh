@@ -289,6 +289,15 @@ assert a["loss_parity_max_abs_diff"] <= 1e-4, a
 assert a["plan"]["total"], a
 assert a["plan"]["unresolved"] == 0, a
 assert a["plan"]["sharded_vars"] > 0, a
+# overlap-schedule A/B (FLAGS_overlap_plan): the static reorder must be
+# BITWISE loss-neutral (it only permutes along dependency edges), must
+# actually hoist something, and the warm step must not regress (>1% with
+# an absolute jitter floor)
+o = result.get("overlap")
+assert o is not None, result.get("overlap_error", result)
+assert o["loss_parity_max_abs_diff"] == 0.0, o
+assert o["plan"]["moves"] >= 1 and o["plan"]["buckets"] >= 1, o
+assert o["on_delta_ok"], o
 # health overhead A/B: FLAGS_health=0 must stay one flag check (the same
 # <=1%/0.25ms gate as trace), and the warm enabled-at-interval-10 loop —
 # fused stat reductions in the step, readback skipped 9 of 10 steps —
@@ -321,6 +330,17 @@ fi
 python -c "import __graft_entry__ as g; g.dryrun_autoshard(8)"
 if [ $? -ne 0 ]; then
     echo "GATE: AUTOSHARD MULTICHIP DRYRUN RED — do not commit" >&2
+    exit 1
+fi
+
+# overlap multichip dryrun: on the dp=4 x mp=2 virtual CPU mesh, with full
+# static verification on, FLAGS_overlap_plan=1 must hoist grad
+# reduce-scatters into the backward section and reproduce the unreordered
+# loss curve BITWISE (max |d| == 0.0) through the real ParallelExecutor,
+# with the critical-path/hoistable-bytes/bucket gauges live
+FLAGS_verify=full python -c "import __graft_entry__ as g; g.dryrun_overlap(8)"
+if [ $? -ne 0 ]; then
+    echo "GATE: OVERLAP MULTICHIP DRYRUN RED — do not commit" >&2
     exit 1
 fi
 
@@ -499,6 +519,22 @@ fi
 JAX_PLATFORMS=cpu python -m paddle_tpu check --selftest --quiet
 if [ $? -ne 0 ]; then
     echo "GATE: CHECK SELFTEST RED — do not commit" >&2
+    exit 1
+fi
+
+# analyze CLI selftests: the SSA graph + hazard detector must pass a clean
+# demo program and flag a seeded cyclic clone (PTA030); the overlap
+# scheduler must produce a non-empty hoisting plan on the zero1-rewritten
+# demo AND reject a seeded collective-order divergence (PTA033) — the
+# "never silently reordered" contract
+JAX_PLATFORMS=cpu python -m paddle_tpu analyze graph --selftest --quiet
+if [ $? -ne 0 ]; then
+    echo "GATE: ANALYZE GRAPH SELFTEST RED — do not commit" >&2
+    exit 1
+fi
+JAX_PLATFORMS=cpu python -m paddle_tpu analyze schedule --selftest --quiet
+if [ $? -ne 0 ]; then
+    echo "GATE: ANALYZE SCHEDULE SELFTEST RED — do not commit" >&2
     exit 1
 fi
 
