@@ -59,6 +59,16 @@ Commands:
                      --chaos-kill-at/--chaos-hang-at N arm a
                      replica_kill/replica_hang fault on the Nth
                      executor dispatch (failover drills).
+  elastic status --master HOST:PORT
+                     membership snapshot of an elastic training job: the
+                     current epoch, live world size and member names
+                     (parallel.elastic; --json for machine parsing) —
+                     the drill/runbook observability command.
+  elastic drain NAME --master HOST:PORT
+                     manually scale DOWN: remove worker NAME from the
+                     membership so the survivors resize at their next
+                     step boundary (the operator-driven twin of the
+                     SIGTERM-drain path).
   fleet router [--replicas ep1,ep2,...] [--master HOST:PORT]
                      run the fleet router: health-checked least-queue
                      routing over the replica set with retry-on-other-
@@ -179,6 +189,16 @@ def _cmd_checkpoint(args):
         dp = manifest.get("datapipe")
         if dp:
             print(f"  datapipe: {dp}")
+        mesh = manifest.get("mesh")
+        if mesh:
+            mesh_s = "×".join(f"{k}={v}" for k, v in mesh.items())
+            print(f"  mesh geometry: [{mesh_s}] (dp may change across a "
+                  f"restore; other axes must match the target mesh)")
+        el = (manifest.get("extra") or {}).get("elastic")
+        if el:
+            print(f"  elastic resize point: epoch={el.get('epoch')} "
+                  f"world_size={el.get('world_size')} "
+                  f"members={el.get('members')}")
         zero1 = manifest.get("zero1")
         if zero1:
             print(f"  zero1 shard layout ({len(zero1)} sharded params; "
@@ -750,6 +770,44 @@ def _cmd_fleet_router(args):
     return 0
 
 
+def _cmd_elastic(args):
+    import json
+
+    from .parallel import elastic as elastic_mod
+
+    if args.elastic_action == "status":
+        try:
+            st = elastic_mod.fetch_status(args.master, timeout=args.timeout)
+        except OSError as e:
+            print(f"cannot reach master {args.master}: {e}",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(st, indent=2))
+        else:
+            print(f"elastic job at {st['endpoint']}: epoch={st['epoch']} "
+                  f"world_size={st['world_size']}")
+            for name, addr in sorted(st["members"].items()):
+                print(f"  {name}" + (f"  {addr}" if addr else ""))
+        return 0
+    if args.elastic_action == "drain":
+        from .parallel.master import MasterClient
+
+        client = MasterClient(args.master, connect_timeout=args.timeout)
+        try:
+            r = client.elastic_leave(args.name)
+        except OSError as e:
+            print(f"cannot reach master {args.master}: {e}",
+                  file=sys.stderr)
+            return 1
+        finally:
+            client.close()
+        print(f"drained {args.name}: membership epoch now {r['epoch']} "
+              f"(survivors resize at their next step boundary)")
+        return 0
+    return 1
+
+
 def _cmd_fleet(args):
     if args.fleet_action == "replica":
         return _cmd_fleet_replica(args)
@@ -1111,6 +1169,25 @@ def main(argv=None):
     fo.add_argument("--hedge-ms", type=float, default=None,
                     help="hedge a silent first attempt after this long")
 
+    e = sub.add_parser("elastic", help="elastic training membership: "
+                                       "status snapshot and manual drain")
+    esub = e.add_subparsers(dest="elastic_action", required=True)
+    es = esub.add_parser("status", help="epoch, world size and members of "
+                                        "a running elastic job")
+    es.add_argument("--master", required=True, metavar="HOST:PORT",
+                    help="the job's parallel.master endpoint")
+    es.add_argument("--timeout", type=float, default=10.0,
+                    help="master connect timeout seconds")
+    es.add_argument("--json", action="store_true",
+                    help="emit the snapshot as JSON")
+    ed = esub.add_parser("drain", help="remove a worker from the "
+                                       "membership (manual scale-down)")
+    ed.add_argument("name", help="worker membership name to remove")
+    ed.add_argument("--master", required=True, metavar="HOST:PORT",
+                    help="the job's parallel.master endpoint")
+    ed.add_argument("--timeout", type=float, default=10.0,
+                    help="master connect timeout seconds")
+
     t = sub.add_parser("train", help="launch a training script with "
                                      "cluster environment")
     t.add_argument("--role", default="trainer",
@@ -1148,6 +1225,8 @@ def main(argv=None):
             return _cmd_trace(args)
         if args.command == "fleet":
             return _cmd_fleet(args)
+        if args.command == "elastic":
+            return _cmd_elastic(args)
         if args.command == "train":
             return _cmd_train(args)
     except BrokenPipeError:

@@ -19,10 +19,15 @@ from . import distributed
 from . import rpc
 from . import ring
 from . import master
+from . import elastic
 from . import sharded_embedding
 from . import flash
 from . import api
-from .mesh import make_mesh, data_parallel_mesh, mesh_scope
+from .mesh import (make_mesh, data_parallel_mesh, mesh_scope,
+                   mesh_geometry, MeshSpec)
+from .elastic import (ElasticController, ElasticConfig, ElasticError,
+                      Resized, RescalePolicy, LinearRescale,
+                      ConstantRescale)
 from .ring import (ring_attention, ring_attention_sharded,
                    ring_flash_attention,
                    ring_flash_attention_sharded)
@@ -32,8 +37,11 @@ from .flash import flash_attention
 
 __all__ = [
     "mesh", "distributed", "rpc", "ring", "sharded_embedding", "api",
-    "flash", "zero1", "autoshard",
+    "flash", "zero1", "autoshard", "elastic",
     "make_mesh", "data_parallel_mesh", "mesh_scope",
+    "mesh_geometry", "MeshSpec",
+    "ElasticController", "ElasticConfig", "ElasticError", "Resized",
+    "RescalePolicy", "LinearRescale", "ConstantRescale",
     "ring_attention", "ring_attention_sharded",
     "ring_flash_attention", "ring_flash_attention_sharded",
     "shard_table", "sharded_embedding_lookup",

@@ -18,6 +18,19 @@ pass where it died (reference snapshots to etcd; here a file, CRC-guarded).
 Transport: the same length-prefixed pickle framing as the variable runtime
 (parallel/rpc.py) — this is control-plane traffic, orders of magnitude off
 the data path.
+
+Elastic membership (parallel/elastic.py rides on this): trainers join a
+TTL'd membership set; every join/leave — explicit, connection close, or
+heartbeat lapse — bumps a monotonically increasing *membership epoch*.
+Heartbeats are generation-fenced: a beat carrying a stale epoch tells the
+worker a resize is pending, and a beat from a lapsed (already-reaped)
+member is refused outright — the worker must re-JOIN, which lands it in a
+strictly newer epoch, so a zombie can never resurrect the epoch the
+survivors already resized away from. The resize barrier releases when
+every member of the target epoch has arrived; if membership moves while
+the barrier forms (concurrent leave+join), waiters are told to restart
+against the new epoch instead of deadlocking on a set that no longer
+exists.
 """
 
 import socket
@@ -97,6 +110,14 @@ class MasterService:
         self.failed = []
         self.cur_pass = 0
         self._registry = {}  # (kind, name) -> (addr, expire_time)
+        # elastic membership: name -> {"addr", "expire", "ttl", "owner"}
+        # (owner = the serving connection that joined it, so a stale
+        # connection's teardown can't evict a member that already
+        # re-joined over a fresh socket)
+        self._members = {}
+        self._membership_epoch = 0
+        self._barrier_arrived = {}  # (epoch, phase) -> set(names)
+        self._barrier_release = {}  # (epoch, phase) -> sorted member list
         self._stop = False
         self._init_done = False
         self._conns = set()  # accepted sockets, closed on stop()
@@ -235,6 +256,9 @@ class MasterService:
                         if exp <= now]
                 for k in dead:
                     del self._registry[k]
+                # elastic membership TTL expiry (heartbeat lapse -> the
+                # survivors get a new epoch and resize)
+                self._reap_members_locked(now)
 
     def counts(self):
         with self._mu:
@@ -254,6 +278,121 @@ class MasterService:
             now = time.monotonic()
             return {name: addr for (k, name), (addr, exp)
                     in self._registry.items() if k == kind and exp > now}
+
+    # ----------------------------------------------------------- membership
+    def _bump_epoch_locked(self):
+        """Every membership change advances the epoch and invalidates any
+        barrier forming against an older one (its waiters restart)."""
+        self._membership_epoch += 1
+        for key in [k for k in self._barrier_arrived
+                    if k[0] != self._membership_epoch]:
+            del self._barrier_arrived[key]
+        for key in [k for k in self._barrier_release
+                    if k[0] < self._membership_epoch - 1]:
+            del self._barrier_release[key]
+        self._mu.notify_all()
+
+    def _reap_members_locked(self, now):
+        """TTL lapse IS a leave: reaping bumps the epoch so survivors
+        resize. A reaped member's later heartbeat is refused (it must
+        re-join under a NEW epoch — never resurrect the old one)."""
+        dead = [n for n, m in self._members.items() if m["expire"] <= now]
+        for n in dead:
+            del self._members[n]
+        if dead:
+            self._bump_epoch_locked()
+        return dead
+
+    def elastic_join(self, name, addr="", ttl=10.0, _owner=None):
+        with self._mu:
+            self._reap_members_locked(time.monotonic())
+            self._members[name] = {"addr": str(addr),
+                                   "expire": time.monotonic() + float(ttl),
+                                   "ttl": float(ttl), "owner": _owner}
+            self._bump_epoch_locked()
+            return {"epoch": self._membership_epoch,
+                    "members": {n: m["addr"]
+                                for n, m in self._members.items()}}
+
+    def elastic_leave(self, name, _owner=None):
+        """Explicit departure (SIGTERM-drain). With _owner set, only
+        evicts a membership this connection created — a dead socket's
+        teardown must not take down the re-joined incarnation."""
+        with self._mu:
+            m = self._members.get(name)
+            if m is not None and (_owner is None or m["owner"] is None
+                                  or m["owner"] == _owner):
+                del self._members[name]
+                self._bump_epoch_locked()
+            return {"epoch": self._membership_epoch}
+
+    def elastic_heartbeat(self, name, epoch):
+        """Generation-fenced liveness. known=False means the member lapsed
+        (or never joined): the TTL reaper already resized the survivors
+        away from it, so refreshing the TTL here would resurrect a stale
+        epoch — the worker must re-join instead."""
+        with self._mu:
+            now = time.monotonic()
+            self._reap_members_locked(now)
+            m = self._members.get(name)
+            if m is None:
+                return {"known": False, "epoch": self._membership_epoch}
+            m["expire"] = now + m["ttl"]
+            return {"known": True, "epoch": self._membership_epoch,
+                    "stale": int(epoch) != self._membership_epoch}
+
+    def elastic_membership(self):
+        with self._mu:
+            self._reap_members_locked(time.monotonic())
+            return {"epoch": self._membership_epoch,
+                    "members": {n: m["addr"]
+                                for n, m in self._members.items()}}
+
+    def elastic_barrier(self, name, epoch, phase="resize", timeout=30.0):
+        """Block until every member of `epoch` arrived at (epoch, phase).
+
+        Returns {"ok": True, "members": [...], "rank": i} on release.
+        If membership moves while the barrier forms (a waiter's TTL
+        lapses, a worker joins, a socket dies) the epoch advances and
+        every waiter gets {"restart": True, "epoch": new} — the
+        controller re-syncs and re-arrives instead of deadlocking on a
+        membership set that no longer exists. Waiting at the barrier IS
+        liveness: each wakeup refreshes the waiter's TTL, so a slow
+        straggler elsewhere can't expire the workers already parked here.
+        """
+        epoch = int(epoch)
+        deadline = time.monotonic() + float(timeout)
+        with self._mu:
+            while True:
+                now = time.monotonic()
+                self._reap_members_locked(now)
+                if self._membership_epoch != epoch:
+                    return {"ok": False, "restart": True,
+                            "epoch": self._membership_epoch}
+                m = self._members.get(name)
+                if m is None:
+                    return {"ok": False, "restart": True, "unknown": True,
+                            "epoch": self._membership_epoch}
+                m["expire"] = now + m["ttl"]
+                key = (epoch, phase)
+                self._barrier_arrived.setdefault(key, set()).add(name)
+                members = self._barrier_release.get(key)
+                if members is None \
+                        and self._barrier_arrived[key] >= set(self._members):
+                    members = sorted(self._members)
+                    self._barrier_release[key] = members
+                    self._mu.notify_all()
+                if members is not None:
+                    return {"ok": True, "epoch": epoch, "phase": phase,
+                            "members": members,
+                            "rank": members.index(name)}
+                if now >= deadline:
+                    return {"ok": False, "timeout": True,
+                            "epoch": self._membership_epoch,
+                            "waiting_for": sorted(
+                                set(self._members)
+                                - self._barrier_arrived.get(key, set()))}
+                self._mu.wait(min(0.05, max(0.001, deadline - now)))
 
     # -------------------------------------------------------------- serving
     def serve(self, bind="127.0.0.1:0"):
@@ -306,6 +445,8 @@ class MasterService:
         # its outstanding leases on disconnect instead of leaking them
         # until the lease timeout stalls the whole pass on one dead peer.
         held = {}  # task_id -> epoch as granted here
+        joined = set()  # member names elastic_join'd over THIS connection
+        owner = id(conn)
         try:
             while True:
                 msg = _rpc._recv_msg(conn)
@@ -331,6 +472,20 @@ class MasterService:
                         reply = ("ok", None)
                     elif op == "lookup":
                         reply = ("ok", self.lookup(args[0]))
+                    elif op == "elastic_join":
+                        joined.add(args[0])
+                        reply = ("ok", self.elastic_join(*args,
+                                                         _owner=owner))
+                    elif op == "elastic_leave":
+                        joined.discard(args[0])
+                        reply = ("ok", self.elastic_leave(args[0],
+                                                          _owner=owner))
+                    elif op == "elastic_heartbeat":
+                        reply = ("ok", self.elastic_heartbeat(*args))
+                    elif op == "elastic_membership":
+                        reply = ("ok", self.elastic_membership())
+                    elif op == "elastic_barrier":
+                        reply = ("ok", self.elastic_barrier(*args))
                     elif op == "counts":
                         reply = ("ok", self.counts())
                     elif op == "exit":
@@ -359,6 +514,12 @@ class MasterService:
                 if requeued:
                     self._maybe_rollover_locked()
                     self._snapshot_locked()
+            # a trainer that dies takes its socket with it: its membership
+            # leaves NOW (survivors resize immediately) instead of waiting
+            # out the TTL. The owner guard keeps this teardown from
+            # evicting a member that already re-joined over a new socket.
+            for name in joined:
+                self.elastic_leave(name, _owner=owner)
             try:
                 conn.close()
             except OSError:
@@ -454,6 +615,23 @@ class MasterClient:
 
     def lookup(self, kind):
         return self._call("lookup", kind)
+
+    # elastic membership (see parallel/elastic.py for the controller that
+    # drives these around a training step loop)
+    def elastic_join(self, name, addr="", ttl=10.0):
+        return self._call("elastic_join", name, addr, ttl)
+
+    def elastic_leave(self, name):
+        return self._call("elastic_leave", name)
+
+    def elastic_heartbeat(self, name, epoch):
+        return self._call("elastic_heartbeat", name, epoch)
+
+    def elastic_membership(self):
+        return self._call("elastic_membership")
+
+    def elastic_barrier(self, name, epoch, phase="resize", timeout=30.0):
+        return self._call("elastic_barrier", name, epoch, phase, timeout)
 
     def counts(self):
         return self._call("counts")

@@ -11,6 +11,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "data_parallel_mesh", "current_mesh", "mesh_scope",
+           "mesh_geometry", "MeshSpec",
            "DP_AXIS", "MP_AXIS", "PP_AXIS", "SP_AXIS"]
 
 DP_AXIS = "dp"   # data parallel (batch)
@@ -50,6 +51,66 @@ def data_parallel_mesh(num_devices=None):
 
 def current_mesh():
     return _current[0]
+
+
+def mesh_geometry(mesh):
+    """{axis: size} of a Mesh (None in -> None out) — the shape that rides
+    checkpoint manifests so a restore can refuse a conflicting mp size."""
+    if mesh is None:
+        return None
+    return {str(a): int(s) for a, s in mesh.shape.items()}
+
+
+class MeshSpec:
+    """Re-formable mesh recipe for elastic training: the non-dp axes are
+    fixed by the model (mp/pp sharding is baked into the checkpoint's
+    meaning), the dp axis is whatever the surviving fleet supports.
+
+        spec = MeshSpec(mp=2)          # dp is elastic, mp pinned at 2
+        mesh = spec.build(dp=4)        # 4x2 over the first 8 devices
+        mesh = spec.build(dp=2)        # re-formed at 2x2 after a shrink
+
+    build() takes the leading `dp * fixed` devices, so shrinking is a pure
+    subset (survivors keep their device slots) and growing re-admits the
+    tail.
+    """
+
+    def __init__(self, **fixed_axes):
+        self.fixed = {str(k): int(v) for k, v in fixed_axes.items()
+                      if k != DP_AXIS}
+        for ax, n in self.fixed.items():
+            if n < 1:
+                raise ValueError(f"mesh axis {ax!r} must be >= 1, got {n}")
+
+    @property
+    def fixed_size(self):
+        return int(np.prod(list(self.fixed.values()))) if self.fixed else 1
+
+    def max_dp(self, devices=None):
+        n = len(devices) if devices is not None else jax.device_count()
+        return n // self.fixed_size
+
+    def build(self, dp, devices=None):
+        dp = int(dp)
+        if dp < 1:
+            raise ValueError(f"dp must be >= 1, got {dp}")
+        devices = list(devices if devices is not None else jax.devices())
+        need = dp * self.fixed_size
+        if need > len(devices):
+            raise ValueError(
+                f"MeshSpec(dp={dp}, {self.fixed}) needs {need} devices, "
+                f"have {len(devices)}")
+        shape = {DP_AXIS: dp}
+        shape.update(self.fixed)
+        return make_mesh(shape, devices=devices[:need])
+
+    def geometry(self, dp):
+        g = {DP_AXIS: int(dp)}
+        g.update(self.fixed)
+        return g
+
+    def __repr__(self):
+        return f"MeshSpec(dp=<elastic>, {self.fixed})"
 
 
 class mesh_scope:

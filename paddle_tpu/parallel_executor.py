@@ -103,6 +103,7 @@ class ParallelExecutor:
         trainer_id=0,
         use_tpu=None,
         mesh_shape=None,
+        devices=None,
         **kwargs,
     ):
         self._program = main_program or default_main_program()
@@ -113,11 +114,16 @@ class ParallelExecutor:
             share_vars_from._scope if share_vars_from is not None else global_scope()
         )
         accel = use_tpu if use_tpu is not None else use_cuda
-        devs = jax.devices()
-        if accel:
-            accel_devs = [d for d in devs if d.platform != "cpu"] or devs
+        if devices is not None:
+            # explicit device subset — the elastic resize path re-forms a
+            # smaller mesh over the survivors' device slots
+            accel_devs = list(devices)
         else:
-            accel_devs = devs
+            devs = jax.devices()
+            if accel:
+                accel_devs = [d for d in devs if d.platform != "cpu"] or devs
+            else:
+                accel_devs = devs
         self._devices = accel_devs
         if mesh_shape:
             # user-declared multi-axis mesh ({"dp": 2, "mp": 4}); variables

@@ -15,11 +15,21 @@ raises UNAVAILABLE, twice" and prove the retry path end to end:
     worker_kill  SIGKILL a datapipe decode worker process  (keyed on map-item index)
     loss_spike   scale the health-recorded loss by `scale` (keyed on global step)
     grad_explode scale the health-recorded grad norms      (keyed on global step)
+    worker_preempt  os.kill(self, SIGTERM)                 (keyed on global step)
+    worker_join  spawn a trainer subprocess from `argv`    (keyed on global step)
 
 delay/transient count *executor run calls* because that is what retry
 wraps (a retried step consumes several run-call indices — set `times` to
 cover the attempts you want to fail). nan/sigterm count the runner's
 *global step*, which survives restore.
+
+worker_preempt/worker_join are the ELASTIC-fleet faults: worker_preempt
+delivers the preemption SIGTERM at step N — with an ElasticController
+installed the dying trainer grace-saves, drains its membership, and the
+survivors resize within one step boundary instead of one TTL.
+worker_join spawns a fresh trainer subprocess (`argv`, tracked in
+monkey.spawned) at step N, so a grow-the-fleet drill is scriptable the
+same way a kill is.
 
 replica_kill/replica_hang are the serving-fleet faults: installed inside
 a replica process (`paddle_tpu fleet replica --chaos-kill-at N`), they
@@ -44,7 +54,8 @@ __all__ = ["Fault", "ChaosMonkey", "install", "uninstall", "active",
            "on_run", "on_map_dispatch"]
 
 _KINDS = ("delay", "transient", "nan", "sigterm", "replica_kill",
-          "replica_hang", "worker_kill", "loss_spike", "grad_explode")
+          "replica_hang", "worker_kill", "loss_spike", "grad_explode",
+          "worker_preempt", "worker_join")
 
 # a "hung" replica is dead-but-connected: default far past any sane
 # request deadline so the router's probes, not patience, end the wait
@@ -53,18 +64,22 @@ _HANG_DEFAULT_MS = 3_600_000.0
 
 class Fault:
     def __init__(self, kind, at, times=1, delay_ms=None, label=None,
-                 scale=1e3):
+                 scale=1e3, argv=None):
         if kind not in _KINDS:
             raise ValueError(f"kind must be one of {_KINDS}, got {kind!r}")
         if delay_ms is None:
             delay_ms = (_HANG_DEFAULT_MS if kind == "replica_hang"
                         else 100.0)
+        if kind == "worker_join" and not argv:
+            raise ValueError("worker_join needs argv (the trainer "
+                             "subprocess command line)")
         self.kind = kind
         self.at = int(at)        # run-call index or global step (see kind)
         self.times = int(times)  # consecutive occurrences from `at`
         self.delay_ms = float(delay_ms)
         self.label = label       # None = any executor; else exact match
         self.scale = float(scale)  # loss_spike/grad_explode multiplier
+        self.argv = list(argv) if argv else None  # worker_join command
         self.fired = 0
 
     def _covers(self, n):
@@ -84,6 +99,7 @@ class ChaosMonkey:
         self.faults = list(faults)
         self.run_calls = 0   # executor dispatches observed
         self.injected = []   # (kind, key, label) log for assertions
+        self.spawned = []    # worker_join subprocess.Popen handles
 
     def add(self, fault):
         self.faults.append(fault)
@@ -137,9 +153,17 @@ class ChaosMonkey:
         """Runner hook, called at each global-step boundary (after the
         step's checkpoint cadence ran)."""
         for f in self.faults:
-            if f.kind == "sigterm" and f._covers(step):
+            if f.kind in ("sigterm", "worker_preempt") and f._covers(step):
+                # worker_preempt is sigterm under its elastic-drill name:
+                # the handler grace-saves, drains membership, and dies,
+                # and the survivors resize at their next step boundary
                 self._fire(f, step)
                 os.kill(os.getpid(), signal.SIGTERM)
+            elif f.kind == "worker_join" and f._covers(step):
+                import subprocess
+
+                self._fire(f, step, "elastic")
+                self.spawned.append(subprocess.Popen(f.argv))
 
     def poison(self, step, metrics):
         """Runner hook: NaN-poison the fetched metrics for step `step`."""

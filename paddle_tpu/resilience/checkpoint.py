@@ -33,7 +33,7 @@ import numpy as np
 from .. import flags
 from .. import monitor
 
-__all__ = ["CheckpointManager", "inspect_dir"]
+__all__ = ["CheckpointManager", "inspect_dir", "check_mesh_compat"]
 
 MANIFEST_FILENAME = "manifest.json"
 STATE_FILENAME = "state.npz"
@@ -56,6 +56,35 @@ def _write_fsync(path, data, mode="w"):
         f.write(data)
         f.flush()
         os.fsync(f.fileno())
+
+
+def check_mesh_compat(ckpt_mesh, expect_mesh):
+    """Refuse a restore whose mesh geometry conflicts with the target.
+
+    The dp axis is layout-independent by contract (zero1/autoshard
+    snapshots are canonical full layout, so a dp=8 checkpoint restores
+    onto dp=4 bitwise) and may differ freely. Every OTHER axis (mp/pp/sp)
+    changes what the saved tensors MEAN — a silent mismatch is silent
+    corruption — so any difference raises ValueError. Missing axes count
+    as size 1; either side None skips the check (pre-mesh checkpoints
+    stay restorable)."""
+    if not ckpt_mesh or not expect_mesh:
+        return
+    from ..parallel.mesh import DP_AXIS
+
+    axes = set(ckpt_mesh) | set(expect_mesh)
+    for ax in sorted(axes):
+        if ax == DP_AXIS:
+            continue
+        have = int(ckpt_mesh.get(ax, 1))
+        want = int(expect_mesh.get(ax, 1))
+        if have != want:
+            raise ValueError(
+                f"checkpoint mesh geometry conflict on axis {ax!r}: "
+                f"checkpoint was saved with {ax}={have}, the target mesh "
+                f"has {ax}={want}. Only the dp axis may change across a "
+                f"restore (layout-independent contract); re-shard the "
+                f"model or restore onto a mesh with matching {ax}.")
 
 
 def _host_value(v):
@@ -106,6 +135,9 @@ class CheckpointManager:
         self.max_num_checkpoints = int(max_num_checkpoints)
         self.async_write = bool(async_write)
         self._predicate = predicate
+        # mesh geometry ({axis: size}) stamped into every manifest; None =
+        # read the ambient parallel.mesh.current_mesh() at save time
+        self.mesh_axes = None
         self._serial = None        # last assigned serial
         self._pending = queue.Queue(maxsize=2)  # bounds host snapshots held
         self._writer = None
@@ -256,6 +288,17 @@ class CheckpointManager:
         ainfo = _autoshard.manifest_section(snap)
         if ainfo:
             manifest["autoshard"] = ainfo
+        # Mesh geometry: which {axis: size} shape produced this state.
+        # Restores compare it against the target mesh and refuse a non-dp
+        # conflict (check_mesh_compat) instead of silently corrupting.
+        mesh_axes = self.mesh_axes
+        if mesh_axes is None:
+            from ..parallel import mesh as _mesh
+
+            mesh_axes = _mesh.mesh_geometry(_mesh.current_mesh())
+        if mesh_axes:
+            manifest["mesh"] = {str(a): int(s)
+                                for a, s in mesh_axes.items()}
         if pipe is not None and hasattr(pipe, "checkpoint_state"):
             manifest["datapipe"] = pipe.checkpoint_state()
         if monitor.enabled():
@@ -296,12 +339,15 @@ class CheckpointManager:
 
         return io_mod._get_latest_checkpoint_serial(self.checkpoint_dir)
 
-    def restore(self, scope=None, program=None, place=None, serial=None):
+    def restore(self, scope=None, program=None, place=None, serial=None,
+                expect_mesh=None):
         """Load the latest (or given) checkpoint's vars into `scope` as
         device arrays; returns the manifest dict, or None when no
         successful checkpoint exists. Restoring a serial written by
         io.save_checkpoint (no manifest) raises — use io.load_checkpoint
-        for the op-based format."""
+        for the op-based format. expect_mesh ({axis: size}) refuses the
+        restore on a non-dp geometry conflict (check_mesh_compat) BEFORE
+        any var is touched."""
         from ..core.scope import global_scope
 
         serial = self.latest_serial() if serial is None else int(serial)
@@ -315,6 +361,8 @@ class CheckpointManager:
                 f"io.load_checkpoint reads the op-based format")
         with open(mpath) as f:
             manifest = json.load(f)
+        if expect_mesh is not None:
+            check_mesh_compat(manifest.get("mesh"), expect_mesh)
         scope = scope if scope is not None else global_scope()
         names = None
         if program is not None:

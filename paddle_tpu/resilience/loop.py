@@ -62,13 +62,19 @@ class ResilienceConfig:
     handle_signals:          install SIGTERM/SIGINT handlers in session()
     save_on_preempt:         blocking grace-save before raising Preempted
     restore_on_start:        restore() picks up the latest checkpoint
+    elastic:                 a parallel.elastic.ElasticController; the
+                             runner starts it in session(), polls it at
+                             every step boundary (raising Resized on an
+                             epoch change) and drains membership before
+                             raising Preempted so the survivors resize
+                             immediately instead of waiting out the TTL
     """
 
     def __init__(self, checkpoint_dir=None, checkpoint_interval=0,
                  max_num_checkpoints=3, async_checkpoints=True,
                  retry=None, nan_policy=None, health_policy=None,
                  handle_signals=True, save_on_preempt=True,
-                 restore_on_start=True):
+                 restore_on_start=True, elastic=None):
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_interval = int(checkpoint_interval)
         self.max_num_checkpoints = int(max_num_checkpoints)
@@ -79,6 +85,7 @@ class ResilienceConfig:
         self.handle_signals = bool(handle_signals)
         self.save_on_preempt = bool(save_on_preempt)
         self.restore_on_start = bool(restore_on_start)
+        self.elastic = elastic
 
 
 class ResilientRunner:
@@ -104,6 +111,7 @@ class ResilientRunner:
             self.retry = cfg.retry
         self.guard = NanGuard(policy=cfg.nan_policy)
         self.preempt = PreemptionHandler() if cfg.handle_signals else None
+        self.elastic = cfg.elastic
         self._in_session = False
 
     # ----------------------------------------------------------- lifecycle
@@ -117,6 +125,9 @@ class ResilientRunner:
         def _session():
             self._in_session = True
             try:
+                if self.elastic is not None \
+                        and not getattr(self.elastic, "_started", False):
+                    self.elastic.start(self)
                 if self.preempt is not None:
                     with self.preempt:
                         yield self
@@ -124,6 +135,8 @@ class ResilientRunner:
                     yield self
             finally:
                 self._in_session = False
+                if self.elastic is not None:
+                    self.elastic.stop()
                 if self.checkpoint is not None:
                     self.checkpoint.wait()
 
@@ -148,6 +161,30 @@ class ResilientRunner:
         if pipe is not None and "datapipe" in manifest \
                 and hasattr(pipe, "restore_state"):
             pipe.restore_state(manifest["datapipe"])
+        return manifest
+
+    def adopt(self, pipe=None, expect_mesh=None):
+        """Adopt the newest COMMITTED checkpoint regardless of
+        restore_on_start — the elastic resize path: every survivor
+        re-seats itself on the fleet's resume point after the commit
+        barrier. expect_mesh ({axis: size}) makes the restore refuse a
+        checkpoint whose mp geometry conflicts with the re-formed mesh.
+        Returns the manifest, or None when there is nothing to adopt."""
+        if self.checkpoint is None:
+            return None
+        self.checkpoint.wait()  # a cadence save may still be in flight
+        manifest = self.checkpoint.restore(
+            scope=self.scope, program=self.program, place=self.place,
+            expect_mesh=expect_mesh)
+        if manifest is None:
+            return None
+        self.global_step = int(manifest.get("step", 0))
+        self.state = dict(manifest.get("extra", {}))
+        if pipe is not None:
+            # tear down the live iteration before repositioning the source
+            pipe.close()
+            if "datapipe" in manifest and hasattr(pipe, "restore_state"):
+                pipe.restore_state(manifest["datapipe"])
         return manifest
 
     def _rollback(self, pipe):
@@ -239,11 +276,17 @@ class ResilientRunner:
         if self.checkpoint is not None and cfg.checkpoint_interval > 0 \
                 and self.global_step % cfg.checkpoint_interval == 0:
             self.save(pipe=pipe)
+        if self.elastic is not None:
+            self.elastic.poll(self, pipe=pipe)  # may raise Resized
         if monkey is not None:
             monkey.on_step(s)  # may deliver an injected SIGTERM
         if self.preempt is not None and self.preempt.pending() is not None:
             serial = None
             if cfg.save_on_preempt and self.checkpoint is not None:
                 serial = self.save(pipe=pipe, block=True)
+            if self.elastic is not None:
+                # SIGTERM-drain: leave the membership before dying so the
+                # survivors resize immediately instead of waiting the TTL
+                self.elastic.drain()
             self.preempt.raise_preempted(checkpoint_serial=serial)
         return metrics

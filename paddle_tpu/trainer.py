@@ -265,7 +265,12 @@ class Trainer:
         dispatch, NaN guard, checkpoint cadence, grace-save on SIGTERM/
         SIGINT (which re-raises resilience.Preempted). Step events carry
         the runner's GLOBAL step id — stable across restores, unlike a
-        per-epoch index."""
+        per-epoch index. With ResilienceConfig(elastic=...) the runner
+        also polls the ElasticController each step: a membership change
+        raises Resized after the scope+pipe adopted the fleet's commit
+        checkpoint, and the loop re-enters on the re-formed mesh exactly
+        like a rollback."""
+        from .parallel.elastic import Resized
         from .resilience import RolledBack
 
         runner = self._resilience
@@ -306,9 +311,10 @@ class Trainer:
                         event_handler(EndStepEvent(
                             epoch_id, runner.global_step - 1, metrics,
                             monitor=snap))
-                except RolledBack:
-                    # scope+pipe rewound to the last checkpoint; re-enter
-                    # the epoch loop from the restored position
+                except (RolledBack, Resized):
+                    # scope+pipe re-seated on a checkpoint (rollback, or
+                    # the elastic commit point after a mesh resize);
+                    # re-enter the epoch loop from the restored position
                     epoch_id = int(runner.state.get("epoch", epoch_id))
                     reseat_rng()
                     continue
@@ -380,6 +386,7 @@ class Trainer:
         but replays the current epoch's records from its start (use a
         datapipe for exact mid-epoch resume); a nan_policy=restore
         rollback likewise restarts the epoch at the checkpoint's params."""
+        from .parallel.elastic import Resized
         from .resilience import RolledBack
 
         with runner.session():
@@ -406,7 +413,7 @@ class Trainer:
                             if monitor_mod.enabled() else None
                         event_handler(EndStepEvent(epoch_id, step_id,
                                                    metrics, monitor=snap))
-                except RolledBack:
+                except (RolledBack, Resized):
                     epoch_id = int(runner.state.get("epoch", epoch_id))
                     continue
                 event_handler(EndEpochEvent(epoch_id))

@@ -355,3 +355,582 @@ def test_pserver_restart_restores_state():
         th2.join(10)
     if os.path.exists(path):
         os.remove(path)
+
+
+# ---------------------------------------------------------------------------
+# elastic membership: epochs, generation-fenced heartbeats, resize barrier
+# (parallel/elastic.py over the master's membership section)
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.parallel.elastic import (  # noqa: E402
+    ConstantRescale, ElasticConfig, ElasticController, ElasticError,
+    LinearRescale, Resized, find_lr_var)
+
+
+def test_lookup_excludes_expired_registrations():
+    """Regression (satellite): lookup() itself must filter TTL-expired
+    registrations — correctness can't depend on the reaper thread having
+    run first."""
+    svc = _svc()
+    svc.register("pserver", "a", "addr-a", ttl=30.0)
+    svc.register("pserver", "b", "addr-b", ttl=0.05)
+    time.sleep(0.2)
+    assert svc.lookup("pserver") == {"a": "addr-a"}
+    # re-registration after the lapse serves again at full TTL
+    svc.register("pserver", "b", "addr-b2", ttl=30.0)
+    assert svc.lookup("pserver") == {"a": "addr-a", "b": "addr-b2"}
+    svc.stop()
+
+
+def test_membership_epoch_bumps_on_join_leave_and_ttl_lapse():
+    svc = _svc()
+    e1 = svc.elastic_join("w0", ttl=30.0)["epoch"]
+    e2 = svc.elastic_join("w1", ttl=0.1)["epoch"]
+    assert e2 == e1 + 1
+    time.sleep(0.3)
+    # w1's TTL lapsed: any membership op reaps it and bumps the epoch
+    m = svc.elastic_membership()
+    assert list(m["members"]) == ["w0"] and m["epoch"] > e2
+    e3 = m["epoch"]
+    # explicit leave bumps again
+    svc.elastic_join("w2", ttl=30.0)
+    e4 = svc.elastic_leave("w2")["epoch"]
+    assert e4 > e3 + 0
+    svc.stop()
+
+
+def test_lapsed_member_heartbeat_refused_and_rejoin_never_resurrects():
+    """Regression (satellite): a heartbeat from a reaped member must NOT
+    refresh the stale membership — known=False forces a re-join, and the
+    re-join lands under a strictly NEWER epoch than the lapse."""
+    svc = _svc()
+    svc.elastic_join("w0", ttl=30.0)
+    e = svc.elastic_join("w1", ttl=0.1)["epoch"]
+    time.sleep(0.3)
+    hb = svc.elastic_heartbeat("w1", e)
+    assert hb["known"] is False and hb["epoch"] > e
+    lapse_epoch = hb["epoch"]
+    # the refused beat did NOT resurrect w1
+    assert list(svc.elastic_membership()["members"]) == ["w0"]
+    # the survivor's beat is generation-fenced: known, but stale
+    hb0 = svc.elastic_heartbeat("w0", e)
+    assert hb0["known"] is True and hb0["stale"] is True
+    # re-join: strictly newer epoch, never the lapsed one
+    e2 = svc.elastic_join("w1", ttl=30.0)["epoch"]
+    assert e2 > lapse_epoch
+    svc.stop()
+
+
+def test_resize_barrier_restarts_on_concurrent_leave_and_join():
+    """Satellite: a barrier forming against epoch E must restart (not
+    deadlock, not release a stale set) when a join AND a leave land while
+    a waiter is parked; the re-formed barrier releases the new set with
+    dense ranks."""
+    svc = _svc()
+    svc.elastic_join("w0", ttl=30.0)
+    e = svc.elastic_join("w1", ttl=30.0)["epoch"]
+    results = {}
+
+    def arrive(name, epoch):
+        results[name] = svc.elastic_barrier(name, epoch, "resize",
+                                            timeout=10.0)
+
+    t = threading.Thread(target=arrive, args=("w0", e), daemon=True)
+    t.start()
+    time.sleep(0.15)  # w0 parked; w1 never arrives
+    svc.elastic_join("w2", ttl=30.0)   # join ...
+    svc.elastic_leave("w1")            # ... and leave in the same window
+    e2 = svc.elastic_membership()["epoch"]
+    t.join(10.0)
+    r = results["w0"]
+    assert r["ok"] is False and r.get("restart") and r["epoch"] == e2
+    ts = [threading.Thread(target=arrive, args=(n, e2), daemon=True)
+          for n in ("w0", "w2")]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join(10.0)
+    assert results["w0"]["ok"] and results["w2"]["ok"]
+    assert results["w0"]["members"] == ["w0", "w2"]
+    assert {results["w0"]["rank"], results["w2"]["rank"]} == {0, 1}
+    svc.stop()
+
+
+def test_commit_barrier_restarts_on_rejoin_during_restore():
+    """Satellite (rejoin-during-restore race): the resize barrier released
+    for epoch E, a straggler re-joins BEFORE the commit barrier — commit
+    must restart so the whole protocol re-runs against the newer epoch
+    and the adopted checkpoint covers the full new set."""
+    svc = _svc()
+    svc.elastic_join("w0", ttl=30.0)
+    e = svc.elastic_join("w1", ttl=30.0)["epoch"]
+    out = {}
+
+    def arrive(name, epoch, phase):
+        out[(name, phase)] = svc.elastic_barrier(name, epoch, phase,
+                                                 timeout=10.0)
+
+    ts = [threading.Thread(target=arrive, args=(n, e, "resize"),
+                           daemon=True) for n in ("w0", "w1")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10.0)
+    assert out[("w0", "resize")]["ok"] and out[("w1", "resize")]["ok"]
+    # straggler lands between the resize and commit barriers
+    e2 = svc.elastic_join("w2", ttl=30.0)["epoch"]
+    r = svc.elastic_barrier("w0", e, "commit", timeout=10.0)
+    assert r["ok"] is False and r.get("restart") and r["epoch"] == e2
+    # the re-run includes the rejoiner
+    ts = [threading.Thread(target=arrive, args=(n, e2, "resize"),
+                           daemon=True) for n in ("w0", "w1", "w2")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10.0)
+    rel = out[("w0", "resize")]
+    assert rel["ok"] and rel["members"] == ["w0", "w1", "w2"]
+    svc.stop()
+
+
+def test_barrier_wait_refreshes_waiter_ttl():
+    """Waiting at the barrier IS liveness: a worker parked longer than its
+    own TTL must not be reaped while it waits for a straggler."""
+    svc = _svc()
+    svc.elastic_join("w0", ttl=0.3)
+    e = svc.elastic_join("w1", ttl=30.0)["epoch"]
+    out = {}
+
+    def park():
+        out["w0"] = svc.elastic_barrier("w0", e, "resize", timeout=10.0)
+
+    t = threading.Thread(target=park, daemon=True)
+    t.start()
+    time.sleep(0.8)  # > w0's TTL: only the in-barrier refresh keeps it
+    assert "w0" in svc.elastic_membership()["members"]
+    out["w1"] = svc.elastic_barrier("w1", e, "resize", timeout=10.0)
+    t.join(10.0)
+    assert out["w0"]["ok"] and out["w1"]["ok"]
+    svc.stop()
+
+
+def test_stale_socket_teardown_does_not_evict_rejoined_member():
+    """Regression (satellite): a worker that re-joined over a NEW
+    connection must survive the OLD connection's death — the disconnect
+    leave is owner-guarded."""
+    svc = _svc()
+    port = svc.serve()
+    try:
+        a = MasterClient(f"127.0.0.1:{port}")
+        b = MasterClient(f"127.0.0.1:{port}")
+        a.elastic_join("w", ttl=30.0)
+        e = b.elastic_join("w", ttl=30.0)["epoch"]  # re-incarnation
+        a.close()  # stale socket dies
+        time.sleep(0.5)  # let the teardown path run
+        m = b.elastic_membership()
+        assert "w" in m["members"], m
+        assert m["epoch"] == e, m  # the guarded leave did not bump
+        # the CURRENT connection's death does evict
+        b.close()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if "w" not in svc.elastic_membership()["members"]:
+                break
+            time.sleep(0.02)
+        assert "w" not in svc.elastic_membership()["members"]
+    finally:
+        svc.stop()
+
+
+def test_controller_resize_on_leave_updates_gauges():
+    from paddle_tpu import monitor
+
+    svc = _svc()
+    kw = dict(ttl=10.0, heartbeat_interval=0.05, start_world=2,
+              barrier_timeout=5.0, resize_timeout=10.0,
+              checkpoint_on_resize=False, restore_on_resize=False)
+    c0 = ElasticController(ElasticConfig(svc, name="w0", **kw))
+    c1 = ElasticController(ElasticConfig(svc, name="w1", **kw))
+    t = threading.Thread(target=c1.start, daemon=True)
+    t.start()
+    c0.start()
+    t.join(10.0)
+    assert c0.world_size == 2 and {c0.rank, c1.rank} == {0, 1}
+    before = monitor.registry().counter("elastic_resizes_total").value
+    c1.drain()
+    deadline = time.monotonic() + 5.0
+    while not c0.resize_pending() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    with pytest.raises(Resized) as ei:
+        c0.poll()
+    assert ei.value.world_size == 1 and ei.value.old_world == 2
+    assert c0.world_size == 1 and c0.rank == 0 and c0.resizes == 1
+    reg = monitor.registry()
+    assert reg.gauge("elastic_world_size").value == 1
+    assert reg.gauge("elastic_epoch").value == c0.epoch
+    assert reg.counter("elastic_resizes_total").value == before + 1
+    assert reg.gauge("elastic_resize_duration_ms").value > 0
+    c0.stop()
+    svc.stop()
+
+
+def test_rescale_policies_and_lr_var():
+    class FakeRunner:
+        checkpoint = None
+
+        def __init__(self):
+            self.scope = fluid.Scope()
+            self.program = None
+
+    r = FakeRunner()
+    r.scope.var("learning_rate_0")
+    r.scope.set_var("learning_rate_0", np.full([1], 0.1, np.float32))
+
+    # policy math
+    assert LinearRescale().lr_scale(2, 4) == 2.0
+    assert LinearRescale().batch_scale(4, 2) == 0.5
+    assert ConstantRescale().lr_scale(2, 8) == 1.0
+
+    svc = _svc()
+    ctl = ElasticController(ElasticConfig(
+        svc, name="w0", lr_var="learning_rate_0",
+        policy=LinearRescale(warmup_steps=2)))
+    ctl._capture_base_lr(r)
+    assert ctl.base_lr == pytest.approx(0.1)
+    ctl.base_world = 2
+
+    def lr():
+        return float(np.asarray(r.scope.find_var("learning_rate_0"))[0])
+
+    # growth 2 -> 4 with warmup: hold, then ramp to target over 2 polls
+    ctl._apply_rescale(2, 4, r)
+    assert lr() == pytest.approx(0.1)
+    ctl.poll(r)
+    assert lr() == pytest.approx(0.15)
+    ctl.poll(r)
+    assert lr() == pytest.approx(0.2)
+    ctl.poll(r)  # ramp exhausted: stable
+    assert lr() == pytest.approx(0.2)
+    # shrink 4 -> 2: new lr applies immediately, no ramp
+    ctl._apply_rescale(4, 2, r)
+    assert lr() == pytest.approx(0.1)
+    svc.stop()
+
+
+def test_find_lr_var():
+    fluid.unique_name.switch()
+    from paddle_tpu.core.framework import Program, program_guard
+
+    main, start = Program(), Program()
+    with program_guard(main, start):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, 2)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    name = find_lr_var(main)
+    assert name is not None and name.startswith("learning_rate")
+    assert find_lr_var(None) is None
+
+
+def test_checkpoint_mesh_geometry_manifest_and_refusal(tmp_path):
+    from paddle_tpu.core.framework import Program, program_guard
+    from paddle_tpu.resilience.checkpoint import (
+        CheckpointManager, check_mesh_compat, inspect_dir)
+
+    fluid.unique_name.switch()
+    scope = fluid.Scope()
+    main, start = Program(), Program()
+    with program_guard(main, start):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.fc(x, 2)
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(start)
+
+    cm = CheckpointManager(str(tmp_path), async_write=False)
+    cm.mesh_axes = {"dp": 4, "mp": 2}
+    cm.save(7, scope=scope, program=main)
+
+    # manifest carries the geometry; `checkpoint inspect` surfaces it
+    rep = inspect_dir(str(tmp_path))
+    assert rep["manifest"]["mesh"] == {"dp": 4, "mp": 2}
+
+    # dp change is the layout-independent contract: allowed
+    m = cm.restore(scope=scope, program=main,
+                   expect_mesh={"dp": 2, "mp": 2})
+    assert m["step"] == 7
+    # mp conflict must refuse with a clear error, not corrupt silently
+    with pytest.raises(ValueError, match="mesh geometry conflict.*mp"):
+        cm.restore(scope=scope, program=main,
+                   expect_mesh={"dp": 4, "mp": 4})
+
+    # unit semantics: None skips; missing axes count as size 1
+    check_mesh_compat(None, {"dp": 2})
+    check_mesh_compat({"dp": 8}, None)
+    check_mesh_compat({"dp": 8}, {"dp": 2})
+    check_mesh_compat({"dp": 4, "mp": 1}, {"dp": 2})
+    with pytest.raises(ValueError):
+        check_mesh_compat({"dp": 4, "pp": 2}, {"dp": 4})
+
+
+def test_mesh_spec_reform():
+    import jax
+
+    from paddle_tpu.parallel.mesh import MeshSpec, mesh_geometry
+
+    spec = MeshSpec(mp=2)
+    n = len(jax.devices())
+    assert spec.max_dp() == n // 2
+    m4 = spec.build(dp=n // 2)
+    assert mesh_geometry(m4) == {"dp": n // 2, "mp": 2}
+    m1 = spec.build(dp=1)  # shrink: leading-device subset
+    assert mesh_geometry(m1) == {"dp": 1, "mp": 2}
+    assert list(np.asarray(m1.devices).flat) == jax.devices()[:2]
+    with pytest.raises(ValueError):
+        spec.build(dp=n)  # would need 2n devices
+    assert spec.geometry(3) == {"dp": 3, "mp": 2}
+    assert mesh_geometry(None) is None
+
+
+def test_chaos_worker_preempt_and_join_kinds():
+    import sys
+
+    from paddle_tpu.resilience.chaos import ChaosMonkey, Fault
+    from paddle_tpu.resilience.preempt import PreemptionHandler
+
+    monkey = ChaosMonkey([Fault("worker_preempt", at=3)])
+    with PreemptionHandler() as h:
+        monkey.on_step(2)
+        assert h.pending() is None
+        monkey.on_step(3)  # SIGTERM to self, captured by the handler
+        assert h.pending() is not None
+    assert ("worker_preempt", 3, None) in monkey.injected
+
+    argv = [sys.executable, "-c", "import sys; sys.exit(7)"]
+    monkey = ChaosMonkey([Fault("worker_join", at=1, argv=argv)])
+    monkey.on_step(0)
+    assert not monkey.spawned
+    monkey.on_step(1)
+    assert len(monkey.spawned) == 1
+    assert monkey.spawned[0].wait(timeout=30) == 7
+    monkey.on_step(1)  # fired cap: no second spawn
+    assert len(monkey.spawned) == 1
+
+    with pytest.raises(ValueError, match="argv"):
+        Fault("worker_join", at=0)
+
+
+def test_elastic_status_cli(capsys):
+    from paddle_tpu import cli
+
+    svc = _svc()
+    port = svc.serve()
+    ep = f"127.0.0.1:{port}"
+    try:
+        svc.elastic_join("w0", "host0:1", ttl=30.0)
+        svc.elastic_join("w1", ttl=30.0)
+        assert cli.main(["elastic", "status", "--master", ep]) == 0
+        out = capsys.readouterr().out
+        assert "world_size=2" in out and "w0" in out and "w1" in out
+        assert cli.main(["elastic", "drain", "w1", "--master", ep]) == 0
+        m = svc.elastic_membership()
+        assert list(m["members"]) == ["w0"]
+        capsys.readouterr()  # drop the drain message
+        assert cli.main(["elastic", "status", "--master", ep,
+                         "--json"]) == 0
+        import json as _json
+
+        st = _json.loads(capsys.readouterr().out)
+        assert st["world_size"] == 1 and list(st["members"]) == ["w0"]
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# the elastic tentpole end to end: dp=4 -> preempt half the fleet -> dp=2
+# -> grow back -> dp=4, loss trajectory bitwise-close to an uninterrupted
+# dp=4 run (the checkpoint-adopt resize loses zero steps)
+# ---------------------------------------------------------------------------
+
+def _parity_program():
+    from paddle_tpu.core.framework import Program, program_guard
+
+    fluid.unique_name.switch()
+    main, start = Program(), Program()
+    with program_guard(main, start):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        p = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, start, loss
+
+
+def _parity_feed(step):
+    # the SAME deterministic global batch per step regardless of world
+    # size: dp only splits the batch, the mean-loss gradient is identical
+    rng = np.random.RandomState(1000 + step)
+    return {"x": rng.standard_normal((8, 4)).astype(np.float32),
+            "y": rng.standard_normal((8, 1)).astype(np.float32)}
+
+
+def _wait_for(cond, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {what}"
+        time.sleep(0.02)
+
+
+def _run_helper(ctl, stop_evt):
+    """A peer trainer reduced to its elastic protocol: join, then answer
+    every barrier the fleet forms (no model of its own)."""
+    try:
+        ctl.start()
+    except ElasticError:
+        return
+    while not stop_evt.is_set():
+        try:
+            ctl.poll()
+        except Resized:
+            pass
+        except ElasticError:
+            return
+        time.sleep(0.005)
+
+
+def test_dp4_to_2_to_4_loss_trajectory_parity(tmp_path):
+    import jax
+
+    from paddle_tpu.resilience import ResilienceConfig, ResilientRunner
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices (conftest forces 8 on CPU)")
+    main, start, loss = _parity_program()
+    place = fluid.CPUPlace()
+    STEPS = 12
+
+    # ---- uninterrupted dp=4 reference
+    ref_scope = fluid.Scope()
+    with fluid.scope_guard(ref_scope):
+        fluid.Executor(place).run(start)
+        init = {}
+        for var in main.list_vars():
+            if var.persistable and ref_scope.find_var(var.name) is not None:
+                init[var.name] = np.array(
+                    np.asarray(ref_scope.find_var(var.name)))
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                    main_program=main,
+                                    devices=jax.devices()[:4])
+        ref = []
+        for s in range(STEPS):
+            out, = pe.run([loss.name], feed=_parity_feed(s))
+            ref.append(float(np.asarray(out).reshape(())))
+
+    # ---- elastic run: 4 workers, preempt 2 after step 4, rejoin at 8
+    svc = _svc()
+    helpers = {}
+
+    def spawn_helper(name):
+        ctl = ElasticController(ElasticConfig(
+            svc, name=name, ttl=10.0, heartbeat_interval=0.05,
+            start_world=4, barrier_timeout=15.0, resize_timeout=30.0,
+            checkpoint_on_resize=False, restore_on_resize=False,
+            policy=ConstantRescale()))
+        evt = threading.Event()
+        th = threading.Thread(target=_run_helper, args=(ctl, evt),
+                              daemon=True)
+        th.start()
+        helpers[name] = (ctl, th, evt)
+
+    def preempt_helper(name):
+        ctl, th, evt = helpers.pop(name)
+        evt.set()
+        # drain BEFORE joining: the leave is what wakes a thread parked
+        # inside a barrier RPC (it then refuses to rejoin and exits)
+        ctl.drain()
+        th.join(10.0)
+
+    for n in ("w1", "w2", "w3"):
+        spawn_helper(n)
+
+    ctl = ElasticController(ElasticConfig(
+        svc, name="w0", ttl=10.0, heartbeat_interval=0.05, start_world=4,
+        barrier_timeout=15.0, resize_timeout=30.0,
+        policy=ConstantRescale(), mesh_spec=fluid.parallel.MeshSpec()))
+    el_scope = fluid.Scope()
+    runner = ResilientRunner(
+        ResilienceConfig(checkpoint_dir=str(tmp_path),
+                         async_checkpoints=False, handle_signals=False,
+                         restore_on_start=False, elastic=ctl),
+        scope=el_scope, program=main, place=place)
+
+    losses, worlds = {}, []
+    with fluid.scope_guard(el_scope):
+        fluid.Executor(place).run(start)
+        for name, val in init.items():  # bit-identical starting point
+            el_scope.set_var(name, val)
+        with runner.session():
+            assert ctl.world_size == 4 and ctl.rank == 0
+
+            def make_pe():
+                return fluid.ParallelExecutor(
+                    use_cuda=False, loss_name=loss.name, main_program=main,
+                    devices=jax.devices()[:ctl.world_size])
+
+            pe = make_pe()
+            while len(losses) < STEPS:
+                s = runner.global_step
+                if s == 4 and ctl.world_size == 4:
+                    for n in ("w2", "w3"):  # preempt half the fleet
+                        preempt_helper(n)
+                    _wait_for(ctl.resize_pending, what="shrink pending")
+                if s == 8 and ctl.world_size == 2:
+                    for n in ("w2", "w3"):  # restarted stragglers rejoin
+                        spawn_helper(n)
+                    _wait_for(
+                        lambda: len(svc.elastic_membership()["members"])
+                        == 4, what="rejoin visible")
+                    _wait_for(ctl.resize_pending, what="grow pending")
+                out, = runner.run_step(
+                    lambda: pe.run([loss.name], feed=_parity_feed(s)))
+                losses[s] = float(np.asarray(out).reshape(()))
+                try:
+                    runner.after_step([out])
+                except Resized as r:
+                    worlds.append(r.world_size)
+                    pe = make_pe()  # re-formed mesh -> fresh executor
+
+    for name in list(helpers):
+        preempt_helper(name)
+    svc.stop()
+
+    assert worlds == [2, 4], worlds
+    assert ctl.resizes == 2
+    got = [losses[s] for s in range(STEPS)]
+    # zero steps lost, exact resume: the elastic trajectory matches the
+    # uninterrupted dp=4 reference step for step
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_drained_controller_refuses_to_rejoin():
+    """Regression: a drained worker whose in-flight barrier RPC returns
+    `unknown` (its own leave already landed) must NOT rejoin — that would
+    resurrect the membership it just gave up and inflate the next resize's
+    world size."""
+    svc = _svc()
+    ctl = ElasticController(ElasticConfig(
+        svc, name="w0", ttl=10.0, heartbeat_interval=0.05,
+        checkpoint_on_resize=False, restore_on_resize=False))
+    ctl.start()
+    assert svc.elastic_membership()["members"] == {"w0": ""}
+    ctl.drain()
+    assert svc.elastic_membership()["members"] == {}
+    # the barrier loop's rejoin branch must refuse while draining
+    ctl._needs_rejoin = True
+    with pytest.raises(ElasticError, match="refusing to rejoin"):
+        ctl._barrier_until_released("resize")
+    # and the step-boundary hook is a no-op on the way down
+    ctl._resize_pending.set()
+    ctl.poll()
+    assert svc.elastic_membership()["members"] == {}
+    svc.stop()

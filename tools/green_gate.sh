@@ -743,4 +743,243 @@ if [ $? -ne 0 ]; then
     exit 1
 fi
 
+# elastic chaos drill: 4 REAL trainer processes on one elastic membership,
+# SIGKILL 2 of them mid-run (no drain, no goodbye) — the survivors must
+# detect the lapse within one lease TTL, re-form the mesh at dp=2 via the
+# rank-0 checkpoint + commit-barrier protocol, and finish with a loss
+# trajectory identical to an uninterrupted dp=4 run (zero steps lost).
+# `paddle_tpu elastic status` is the mid-incident view a human would use.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, signal, subprocess, sys, tempfile, time
+import numpy as np
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+import paddle_tpu as fluid
+from paddle_tpu.parallel.master import MasterService, MasterClient
+
+tmp = tempfile.mkdtemp(prefix="elastic_gate_")
+STEPS = 24
+
+WORKER = r'''
+import json, os, sys, time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import numpy as np
+import jax
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.parallel.elastic import (ElasticController, ElasticConfig,
+                                         ConstantRescale, Resized)
+from paddle_tpu.resilience import ResilienceConfig, ResilientRunner
+
+endpoint, name, ckpt_dir, tmp, steps = (sys.argv[1], sys.argv[2],
+                                        sys.argv[3], sys.argv[4],
+                                        int(sys.argv[5]))
+
+main, start = fluid.Program(), fluid.Program()
+with fluid.unique_name.guard(), fluid.program_guard(main, start):
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(x, 8, act="relu")
+    p = fluid.layers.fc(h, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+
+def feed_for(s):
+    rng = np.random.RandomState(7000 + s)
+    return {"x": rng.standard_normal((8, 4)).astype(np.float32),
+            "y": rng.standard_normal((8, 1)).astype(np.float32)}
+
+
+scope = fluid.Scope()
+ctl = ElasticController(ElasticConfig(
+    endpoint, name=name, ttl=1.5, heartbeat_interval=0.3, start_world=4,
+    policy=ConstantRescale(), mesh_spec=fluid.parallel.MeshSpec()))
+runner = ResilientRunner(
+    ResilienceConfig(checkpoint_dir=ckpt_dir, async_checkpoints=False,
+                     handle_signals=False, restore_on_start=False,
+                     elastic=ctl),
+    scope=scope, program=main, place=fluid.CPUPlace())
+
+losses = {}
+with fluid.scope_guard(scope):
+    fluid.Executor(fluid.CPUPlace()).run(start)
+    rng = np.random.RandomState(0)  # every process: identical init
+    for var in sorted((v for v in main.list_vars()
+                       if v.persistable and v.name.startswith("fc_")),
+                      key=lambda v: v.name):
+        shape = np.asarray(scope.find_var(var.name)).shape
+        scope.set_var(var.name,
+                      (rng.standard_normal(shape) * 0.5).astype(np.float32))
+    with runner.session():
+        def make_pe():
+            return fluid.ParallelExecutor(
+                use_cuda=False, loss_name=loss.name, main_program=main,
+                devices=jax.devices()[:ctl.world_size])
+
+        pe = make_pe()
+        while runner.global_step < steps:
+            s = runner.global_step
+            out, = runner.run_step(lambda: pe.run([loss.name],
+                                                  feed=feed_for(s)))
+            losses[s] = float(np.asarray(out).reshape(()))
+            with open(os.path.join(tmp, "step_" + name), "w") as f:
+                f.write(str(s))
+            time.sleep(0.25)
+            try:
+                runner.after_step([out])
+            except Resized:
+                pe = make_pe()  # re-formed mesh -> fresh executor
+
+snap = monitor.registry().snapshot()
+with open(os.path.join(tmp, "out_" + name + ".json"), "w") as f:
+    json.dump({"losses": {str(k): v for k, v in losses.items()},
+               "status": ctl.status(), "resizes": ctl.resizes,
+               "world_size": ctl.world_size, "rank": ctl.rank,
+               "gauge_world": snap.get("elastic_world_size"),
+               "resizes_total": snap.get("elastic_resizes_total")}, f)
+'''
+
+worker_py = os.path.join(tmp, "worker.py")
+with open(worker_py, "w") as f:
+    f.write(WORKER)
+ckpt = os.path.join(tmp, "ckpt")
+os.makedirs(ckpt)
+
+# uninterrupted dp=4 reference, same program/init/feeds as the workers
+main, start = fluid.Program(), fluid.Program()
+with fluid.unique_name.guard(), fluid.program_guard(main, start):
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(x, 8, act="relu")
+    p = fluid.layers.fc(h, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+ref_scope = fluid.Scope()
+with fluid.scope_guard(ref_scope):
+    fluid.Executor(fluid.CPUPlace()).run(start)
+    rng = np.random.RandomState(0)
+    for var in sorted((v for v in main.list_vars()
+                       if v.persistable and v.name.startswith("fc_")),
+                      key=lambda v: v.name):
+        shape = np.asarray(ref_scope.find_var(var.name)).shape
+        ref_scope.set_var(var.name,
+                          (rng.standard_normal(shape) * 0.5)
+                          .astype(np.float32))
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                main_program=main,
+                                devices=jax.devices()[:4])
+    ref = []
+    for s in range(STEPS):
+        rs = np.random.RandomState(7000 + s)
+        out, = pe.run([loss.name],
+                      feed={"x": rs.standard_normal((8, 4))
+                            .astype(np.float32),
+                            "y": rs.standard_normal((8, 1))
+                            .astype(np.float32)})
+        ref.append(float(np.asarray(out).reshape(())))
+
+svc = MasterService(lease_timeout=30.0, failure_max=2)
+port = svc.serve()
+ep = f"127.0.0.1:{port}"
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=os.getcwd() + os.pathsep
+           + os.environ.get("PYTHONPATH", ""))
+procs, errs = [], []
+try:
+    for i in range(4):
+        errs.append(open(os.path.join(tmp, f"err_w{i}"), "w"))
+        procs.append(subprocess.Popen(
+            [sys.executable, worker_py, ep, f"w{i}", ckpt, tmp,
+             str(STEPS)],
+            env=env, stdout=subprocess.DEVNULL, stderr=errs[i]))
+
+    cli = MasterClient(ep)
+
+    def prog(i):
+        try:
+            with open(os.path.join(tmp, f"step_w{i}")) as f:
+                return int(f.read() or 0)
+        except Exception:
+            return -1
+
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        if len(cli.elastic_membership()["members"]) == 4 \
+                and min(prog(i) for i in range(4)) >= 4:
+            break
+        time.sleep(0.1)
+    assert len(cli.elastic_membership()["members"]) == 4, \
+        "fleet never assembled at dp=4"
+
+    # chaos: SIGKILL half the fleet — uncatchable, no drain runs
+    for i in (2, 3):
+        os.kill(procs[i].pid, signal.SIGKILL)
+    t_kill = time.time()
+    while len(cli.elastic_membership()["members"]) > 2 \
+            and time.time() - t_kill < 20:
+        time.sleep(0.05)
+    t_detect = time.time() - t_kill
+    m = cli.elastic_membership()
+    assert sorted(m["members"]) == ["w0", "w1"], m
+    # THE contract: lapse detected within one lease TTL (1.5 s) plus a
+    # heartbeat round of slack
+    assert t_detect < 2.5, f"lapse detected only after {t_detect:.1f}s"
+
+    # the status CLI a human reaches for mid-incident
+    st = json.loads(subprocess.check_output(
+        [sys.executable, "-m", "paddle_tpu", "elastic", "status",
+         "--master", ep, "--json"], env=env).decode())
+    assert st["world_size"] == 2, st
+    assert sorted(st["members"]) == ["w0", "w1"], st
+
+    for i in (0, 1):
+        rc = procs[i].wait(timeout=240)
+        if rc != 0:
+            errs[i].flush()
+            with open(os.path.join(tmp, f"err_w{i}")) as f:
+                sys.stderr.write(f.read()[-3000:])
+        assert rc == 0, f"survivor w{i} exited {rc}"
+
+    outs = {}
+    for i in (0, 1):
+        with open(os.path.join(tmp, f"out_w{i}.json")) as f:
+            outs[i] = json.load(f)
+    # rank 0 survived with the FULL trajectory: zero steps lost, and the
+    # dp=4 -> dp=2 resize left the loss curve identical to the reference
+    l0 = outs[0]["losses"]
+    assert len(l0) == STEPS, sorted(l0)
+    for s in range(STEPS):
+        assert abs(l0[str(s)] - ref[s]) < 1e-4, (s, l0[str(s)], ref[s])
+    # the adopter's steps (it may have jumped to rank 0's checkpoint
+    # position) sit on the same curve
+    for s, v in outs[1]["losses"].items():
+        assert abs(v - ref[int(s)]) < 1e-4, (s, v, ref[int(s)])
+    assert outs[0]["resizes"] >= 1 and outs[0]["world_size"] == 2, outs[0]
+    assert outs[0]["rank"] == 0
+    assert outs[0]["gauge_world"] == 2, outs[0]
+    assert outs[0]["resizes_total"] >= 1, outs[0]
+    cli.close()
+    print(f"elastic chaos drill: ok (SIGKILL 2/4, lapse detected in "
+          f"{t_detect * 1000:.0f} ms, {outs[0]['resizes']} resize(s), "
+          f"{STEPS} steps loss-parity at dp=2)")
+finally:
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=10)
+    for f in errs:
+        f.close()
+    svc.stop()
+    import shutil
+    shutil.rmtree(tmp, ignore_errors=True)
+EOF
+if [ $? -ne 0 ]; then
+    echo "GATE: ELASTIC CHAOS DRILL RED — do not commit" >&2
+    exit 1
+fi
+
 echo "GATE: green"
