@@ -1114,6 +1114,99 @@ def measure_dry_overlap(fluid):
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def _cache_child(fluid):
+    """bench.py --cache-child: one process of measure_dry_cache's
+    cold/warm pair. Builds the measure_dry MLP, times program-build ->
+    first fetched step (the wall time the persistent cache is meant to
+    cut), runs two warm calls, and reports the monitor's compile_cache
+    counters so the parent can assert the warm process compiled nothing.
+    The cache dir arrives via FLAGS_compile_cache_dir in the env."""
+    from paddle_tpu import flags, monitor
+
+    flags.set("monitor", True)
+    monitor.reset()
+    K, batch = 4, 8
+    t0 = time.perf_counter()
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int32")
+        net = fluid.layers.fc(input=x, size=32, act="relu")
+        predict = fluid.layers.fc(input=net, size=8, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=predict, label=label))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        feeds = {
+            "x": rs.rand(K, batch, 16).astype(np.float32),
+            "label": rs.randint(0, 8, (K, batch, 1)).astype(np.int32),
+        }
+        first = exe.run(prog, feed=feeds, fetch_list=[loss], iters=K)
+        start_ms = (time.perf_counter() - t0) * 1000.0
+        for _ in range(2):
+            exe.run(prog, feed=feeds, fetch_list=[loss], iters=K)
+    snap = monitor.registry().snapshot()
+    misses = sum(v for k, v in snap.items()
+                 if "compile_cache_misses_total" in k)
+    return {
+        "start_to_first_step_ms": round(start_ms, 2),
+        "first_loss": float(np.asarray(first[0]).reshape(-1)[0]),
+        "compile_cache_misses": int(misses),
+        "cache_info": exe.compile_cache_info(),
+        "l2_counters": {k: v for k, v in snap.items()
+                        if "compile_cache_l2" in k},
+    }
+
+
+def measure_dry_cache(fluid):
+    """bench.py --dry persistent-cache block: the warm-start contract,
+    proven cross-process. Two child runs of the same program share one
+    FLAGS_compile_cache_dir — the first (cold) populates the L2 store,
+    the second (warm) must report compile_cache_misses == 0 (every
+    executable deserialized, nothing retraced) and the identical first
+    loss, with a faster start-to-first-step wall time."""
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def run_child(cache_dir):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["FLAGS_compile_cache_dir"] = cache_dir
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"),
+             "--cache-child"],
+            env=env, cwd=repo, capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"cache child failed (rc={proc.returncode}): "
+                f"{proc.stderr[-500:]}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    with tempfile.TemporaryDirectory(prefix="ptac_bench_") as d:
+        cold = run_child(d)
+        warm = run_child(d)
+    cold_ms = cold["start_to_first_step_ms"]
+    warm_ms = warm["start_to_first_step_ms"]
+    return {
+        "cold_start_to_first_step_ms": cold_ms,
+        "warm_start_to_first_step_ms": warm_ms,
+        "warm_speedup": round(cold_ms / warm_ms, 2) if warm_ms else None,
+        "cold_misses": cold["compile_cache_misses"],
+        "warm_misses": warm["compile_cache_misses"],
+        "warm_misses_ok": warm["compile_cache_misses"] == 0,
+        "loss_parity": cold["first_loss"] == warm["first_loss"],
+        "l2_puts": cold["cache_info"]["l2"]["puts"],
+        "l2_put_bytes": cold["cache_info"]["l2"]["put_bytes"],
+        "warm_l2_hits": warm["cache_info"]["l2"]["hits"],
+    }
+
+
 def measure_dry(fluid):
     """bench.py --dry: a tiny MLP through the SAME public exe.run(iters=K)
     path with the monitor + HLO cost capture on, emitting the same
@@ -1294,6 +1387,12 @@ def measure_dry(fluid):
         result["overlap"] = measure_dry_overlap(fluid)
     except Exception as e:
         result["overlap_error"] = f"{type(e).__name__}: {e}"
+    # persistent AOT cache: cold vs warm start-to-first-step across two
+    # processes sharing one cache dir — the warm child must compile nothing
+    try:
+        result["cache_persist"] = measure_dry_cache(fluid)
+    except Exception as e:
+        result["cache_persist_error"] = f"{type(e).__name__}: {e}"
     # serving mode, CI-sized: the same A/B the full --serve run does
     # (unbatched vs Server QPS, percentiles, zero-steady-compile check);
     # runs AFTER the cache snapshot above because it resets the monitor
@@ -1419,6 +1518,11 @@ def main():
     if "--overlap-dry" in sys.argv:
         # child mode of measure_dry_overlap (8-device virtual CPU mesh)
         print(json.dumps(_overlap_ab(fluid)))
+        return
+
+    if "--cache-child" in sys.argv:
+        # child mode of measure_dry_cache (one half of the cold/warm pair)
+        print(json.dumps(_cache_child(fluid)))
         return
 
     if "--serve" in sys.argv:

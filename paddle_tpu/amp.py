@@ -108,11 +108,14 @@ def is_enabled():
 
 def fingerprint():
     """Hashable policy signature — part of every executor compile-cache key
-    (a cached fp32 step must not be reused after enabling bf16)."""
+    (a cached fp32 step must not be reused after enabling bf16). Sorted
+    tuples, not hash(frozenset): the signature also feeds the PERSISTENT
+    compile-cache digest, which must be stable across processes
+    (PYTHONHASHSEED makes hash() process-local)."""
     if not _state["enabled"]:
         return ("amp-off",)
     return ("amp", _state["dtype"],
-            hash(_state["white"]), hash(_state["black"]))
+            tuple(sorted(_state["white"])), tuple(sorted(_state["black"])))
 
 
 @contextlib.contextmanager

@@ -1,0 +1,282 @@
+"""paddle_tpu.cache — the pluggable compile cache behind both executors.
+
+Two levels:
+
+  L1  in-process OrderedDict of live compiled callables, keyed by the
+      executor's (id(program), mutation, ...) tuple. True LRU: a hit
+      moves the entry to the tail, the FLAGS_compile_cache_cap eviction
+      pops the head — so a hot entry is never evicted to make room (the
+      old per-executor dicts popped insertion order regardless of use).
+
+  L2  optional persistent store (FLAGS_compile_cache_dir, store.L2Store)
+      of executables serialized via jax.experimental.serialize_executable,
+      keyed by a process-stable content digest (keys.stable_digest). A
+      process that misses L1 but hits L2 deserializes instead of
+      compiling — sub-second warm start for fleet replica spin-up,
+      resilience restore, and elastic resize. Absent entry = l2_miss;
+      corrupt / version-stale / undeserializable entry = l2_fallback
+      (counted, silently recompiled — NEVER an exception to run()).
+
+The executors own one CompileCache each (kind "executor" /
+"parallel_executor"). Instance counters (hits/misses/evictions + the
+l2_* family) always track and surface through compile_cache_info();
+monitor-registry counters additionally tick when FLAGS_monitor is on
+(the disabled-mode contract keeps the registry untouched otherwise).
+"""
+
+import pickle
+from collections import OrderedDict
+
+from .. import flags
+from .keys import environment, program_digest, stable_digest
+from .store import L2Store
+
+__all__ = ["CompileCache", "L2Store", "default_store", "environment",
+           "program_digest", "serialize_support", "stable_digest"]
+
+flags.define(
+    "compile_cache_dir", str, "",
+    "Persistent compile-cache directory (the L2 behind each executor's "
+    "in-memory cache): compiled step executables are serialized via JAX "
+    "AOT export and re-loaded by later processes, so a restarted fleet "
+    "replica or a resized elastic worker starts with zero compiles. "
+    "Entries are invalidated by content digest (program, feed specs, "
+    "amp/zero1/autoshard/overlap config, jax+jaxlib version, device "
+    "geometry). Empty: disabled.")
+flags.define(
+    "compile_cache_dir_max_mb", int, 2048,
+    "Size cap for FLAGS_compile_cache_dir in MiB. After every store "
+    "write the directory is pruned oldest-used-first (mtime LRU) down "
+    "to the cap; <= 0 leaves it unbounded.")
+
+_SE_UNSET = object()
+_se_mod = [_SE_UNSET]
+
+
+def serialize_support():
+    """jax.experimental.serialize_executable, or None when this jax build
+    doesn't ship it — L2 then degrades to disabled instead of raising."""
+    if _se_mod[0] is _SE_UNSET:
+        try:
+            from jax.experimental import serialize_executable as se
+
+            _se_mod[0] = se
+        except Exception:
+            _se_mod[0] = None
+    return _se_mod[0]
+
+
+def default_store():
+    """L2Store at FLAGS_compile_cache_dir, or None when the flag is empty
+    (re-read per call: tests and the fleet CLI flip the flag at runtime)."""
+    root = flags.get("compile_cache_dir")
+    return L2Store(root) if root else None
+
+
+def _l2_count(which, kind, n=1):
+    """Registry counter compile_cache_l2_<which>_total{cache=kind}, gated
+    on monitor.enabled() (the FLAGS_monitor=0 no-registry contract)."""
+    from .. import monitor
+
+    if monitor.enabled():
+        monitor.cache_l2(kind, which, n)
+
+
+class CompileCache:
+    """One executor's compile cache: Mapping-like L1 LRU + optional L2.
+
+    Keeps the raw-dict surface tests and tools poke (len/iter/in/
+    values/items/[]), so swapping it in for the old `_compile_cache = {}`
+    is invisible to callers that only read.
+    """
+
+    def __init__(self, kind="executor"):
+        self.kind = kind
+        self._entries = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.l2_hits = 0
+        self.l2_misses = 0
+        self.l2_fallbacks = 0
+        self.l2_puts = 0
+        self.l2_put_bytes = 0
+
+    # -- L1 ------------------------------------------------------------
+    def get(self, key):
+        """Counted LRU lookup: a hit refreshes the entry's recency."""
+        entry = self._entries.get(key)
+        if entry is not None:
+            self.hits += 1
+            self._entries.move_to_end(key)
+        else:
+            self.misses += 1
+        return entry
+
+    def put(self, key, entry, mon=None):
+        """Insert at the recency tail, evicting least-recently-USED heads
+        while FLAGS_compile_cache_cap bounds the cache."""
+        cap = flags.get("compile_cache_cap")
+        if cap and cap > 0:
+            while len(self._entries) >= cap \
+                    and key not in self._entries:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+                if mon is not None:
+                    from .. import monitor
+
+                    monitor.cache_evicted(self.kind)
+                    if mon.extra is None:
+                        mon.extra = {}
+                    mon.extra["cache_evictions"] = \
+                        mon.extra.get("cache_evictions", 0) + 1
+        self._entries[key] = entry
+        self._entries.move_to_end(key)
+
+    # read-only dict surface (external observers; no counter side effects)
+    def __len__(self):
+        return len(self._entries)
+
+    def __iter__(self):
+        return iter(self._entries)
+
+    def __contains__(self, key):
+        return key in self._entries
+
+    def __getitem__(self, key):
+        return self._entries[key]
+
+    def keys(self):
+        return self._entries.keys()
+
+    def values(self):
+        return self._entries.values()
+
+    def items(self):
+        return self._entries.items()
+
+    def clear(self):
+        self._entries.clear()
+
+    def info(self):
+        """compile_cache_info() payload; "entries" key preserved (the
+        serving engine diffs it across warmup)."""
+        return {
+            "entries": len(self._entries),
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "l2": {
+                "enabled": self.l2_enabled(),
+                "dir": flags.get("compile_cache_dir") or None,
+                "hits": self.l2_hits,
+                "misses": self.l2_misses,
+                "fallbacks": self.l2_fallbacks,
+                "puts": self.l2_puts,
+                "put_bytes": self.l2_put_bytes,
+            },
+        }
+
+    # -- L2 ------------------------------------------------------------
+    def l2_enabled(self):
+        return bool(flags.get("compile_cache_dir")) \
+            and serialize_support() is not None
+
+    def store(self):
+        return default_store()
+
+    def l2_digest(self, program, key_tail, extra=()):
+        """Stable store key for one L1 key: its content tail (everything
+        after the (id, mutation) head) + the executor kind + the caller's
+        device/mesh context."""
+        return stable_digest(
+            program, key_tail,
+            extra=(("kind", self.kind),) + tuple(extra))
+
+    def l2_load(self, digest, mon=None):
+        """Deserialize one stored executable into a callable Compiled.
+        None on miss or fallback (corrupt / version-stale / deserialize
+        failure) — counted, never raised."""
+        store = self.store()
+        se = serialize_support()
+        if store is None or se is None or digest is None:
+            return None
+        outcome, payload, _header = store.get(digest)
+        if outcome == "miss":
+            self.l2_misses += 1
+            _l2_count("misses", self.kind)
+            return None
+        if outcome != "hit":
+            self.count_l2_fallback(mon, reason=outcome)
+            return None
+        try:
+            parts = pickle.loads(payload)
+            compiled = se.deserialize_and_load(*parts)
+        except Exception:
+            self.count_l2_fallback(mon, reason="deserialize")
+            return None
+        self.l2_hits += 1
+        _l2_count("hits", self.kind)
+        return compiled
+
+    def count_l2_fallback(self, mon=None, reason=None):
+        self.l2_fallbacks += 1
+        _l2_count("fallbacks", self.kind)
+        if mon is not None:
+            if mon.extra is None:
+                mon.extra = {}
+            mon.extra["cache_l2_fallback"] = reason or "fallback"
+
+    def aot_sink(self, digest, meta=None):
+        """Export callback for executor_core.compile_step_fn(aot=...):
+        receives the freshly AOT-compiled executable once, right after its
+        first execution is set up, and serializes it into the store. None
+        when L2 is off (compile_step_fn then skips the AOT detour). Export
+        failures are swallowed — a cache write must never fail the step."""
+        if digest is None or not self.l2_enabled():
+            return None
+
+        def sink(compiled_exe):
+            store = self.store()
+            se = serialize_support()
+            if store is None or se is None:
+                return
+            try:
+                payload = pickle.dumps(
+                    se.serialize(compiled_exe),
+                    protocol=pickle.HIGHEST_PROTOCOL)
+                max_mb = int(flags.get("compile_cache_dir_max_mb"))
+                nbytes = store.put(
+                    digest, payload, kind=self.kind, meta=meta,
+                    max_bytes=max_mb * (1 << 20) if max_mb > 0 else None)
+            except Exception:
+                return
+            self.l2_puts += 1
+            self.l2_put_bytes += nbytes
+            _l2_count("puts", self.kind)
+            _l2_count("put_bytes", self.kind, nbytes)
+
+        return sink
+
+    def guard_l2(self, loaded, rebuild, mon=None):
+        """Wrap a deserialized executable so a latent incompatibility the
+        header checks can't see (aval/sharding/device-assignment drift)
+        surfaces on the FIRST call — jax validates arguments before
+        dispatch, so the TypeError/ValueError arrives with no buffer
+        donated yet and it is safe to rebuild fresh and retry. After one
+        clean call the loaded executable is trusted unguarded."""
+        box = [None]
+
+        def call(*args):
+            if box[0] is not None:
+                return box[0](*args)
+            try:
+                out = loaded(*args)
+            except (TypeError, ValueError):
+                self.count_l2_fallback(mon, reason="call")
+                box[0] = rebuild()
+                return box[0](*args)
+            box[0] = loaded
+            return out
+
+        return call

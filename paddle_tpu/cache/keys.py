@@ -1,0 +1,83 @@
+"""Stable content digests for the persistent compile cache (L2).
+
+The in-memory compile-cache key pins a program by (id(program),
+program._mutation) — perfect within a process, meaningless across
+processes. The L2 key replaces that pair with a sha256 of the program's
+canonical JSON serialization (Program.desc_str: sort_keys, ops in program
+order) and appends everything else that changes the compiled executable:
+
+  * the in-memory key's content tail (feed shape/dtype specs, fetch and
+    state name tuples, amp/wire/donate/iters/health and — on the
+    ParallelExecutor — zero1/overlap/autoshard digests), which is already
+    process-stable by construction (sorted tuples of primitives; no id()s,
+    no hash()es)
+  * the runtime environment: jax + jaxlib versions and the backend
+    platform (an executable serialized by one XLA build must never be fed
+    to another — the store ALSO stamps these in the entry header and
+    re-checks at load)
+  * the device geometry the caller passes as `extra` (device ids, mesh
+    axis names/sizes): a serialized executable is bound to its device
+    assignment, so a resized mesh takes a clean miss instead of a
+    deserialize-time failure.
+
+Never hash() anything here: PYTHONHASHSEED makes it process-local. The
+cross-process stability contract is asserted by a subprocess test in
+tests/test_compile_cache.py.
+"""
+
+import hashlib
+
+__all__ = ["program_digest", "stable_digest", "environment"]
+
+# program content digests, keyed (id(program), mutation) — sha256 of a big
+# JSON string is the expensive part, and it is only ever needed on the
+# compile-cache miss path, so a small FIFO memo keeps repeat misses (new
+# feed shapes against one program) from re-serializing the ProgramDesc
+_digest_memo = {}
+_DIGEST_MEMO_CAP = 128
+
+
+def program_digest(program):
+    """sha256 hex of the program's canonical serialization."""
+    key = (id(program), program._mutation)
+    hit = _digest_memo.get(key)
+    if hit is not None:
+        return hit
+    d = hashlib.sha256(program.desc_str().encode("utf-8")).hexdigest()
+    while len(_digest_memo) >= _DIGEST_MEMO_CAP:
+        _digest_memo.pop(next(iter(_digest_memo)))
+    _digest_memo[key] = d
+    return d
+
+
+def environment():
+    """(jax, jaxlib, backend platform) triple stamped into every entry and
+    folded into every digest — a version bump is an automatic cold start."""
+    import jax
+    import jaxlib
+
+    backend = "unknown"
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        pass
+    return (jax.__version__, jaxlib.__version__, backend)
+
+
+def stable_digest(program, key_tail, extra=()):
+    """Hex digest naming one L2 entry.
+
+    key_tail: the in-memory cache key MINUS its (id, mutation) head —
+    tuples of primitives whose repr is process-stable. extra: caller
+    context (executor kind, device ids, mesh geometry).
+    """
+    h = hashlib.sha256()
+    h.update(b"paddle_tpu-aot-v1\0")
+    h.update(repr(environment()).encode("utf-8"))
+    h.update(b"\0")
+    h.update(program_digest(program).encode("utf-8"))
+    h.update(b"\0")
+    h.update(repr(tuple(key_tail)).encode("utf-8"))
+    h.update(b"\0")
+    h.update(repr(tuple(extra)).encode("utf-8"))
+    return h.hexdigest()

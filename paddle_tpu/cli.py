@@ -158,6 +158,69 @@ def _cmd_health(args):
     return 2
 
 
+def _fmt_age(seconds):
+    s = float(seconds)
+    if s < 60:
+        return f"{s:.0f}s"
+    if s < 3600:
+        return f"{s / 60:.0f}m"
+    if s < 86400:
+        return f"{s / 3600:.1f}h"
+    return f"{s / 86400:.1f}d"
+
+
+def _cmd_cache(args):
+    import json
+
+    from . import flags
+    from .cache import L2Store
+
+    root = args.dir or flags.get("compile_cache_dir")
+    if not root:
+        print("no cache dir: pass --dir or set FLAGS_compile_cache_dir",
+              file=sys.stderr)
+        return 2
+    if not os.path.isdir(root):
+        print(f"not a directory: {root}", file=sys.stderr)
+        return 2
+    store = L2Store(root)
+    if args.cache_action == "ls":
+        ents = store.entries()
+        if args.json:
+            print(json.dumps({
+                "dir": root,
+                "total_bytes": sum(e["bytes"] for e in ents),
+                "entries": ents,
+            }, indent=2))
+            return 0
+        if not ents:
+            print(f"{root}: empty")
+            return 0
+        print(f"{'digest':<18} {'kind':<20} {'bytes':>10} {'age':>7} "
+              f"{'jaxlib':<12} status")
+        for e in ents:
+            print(f"{e['digest'][:16] + '..':<18} "
+                  f"{e.get('kind', '?'):<20} {e['bytes']:>10} "
+                  f"{_fmt_age(e['age_s']):>7} {e.get('jaxlib', '?'):<12} "
+                  f"{'ok' if e['ok'] else 'CORRUPT'}")
+        total = sum(e["bytes"] for e in ents)
+        print(f"{len(ents)} entries, {total / 1e6:.1f} MB in {root}")
+        return 0
+    if args.cache_action == "prune":
+        max_mb = args.max_mb if args.max_mb is not None \
+            else flags.get("compile_cache_dir_max_mb")
+        removed = store.prune(int(max_mb) * (1 << 20))
+        print(f"pruned {removed} entries "
+              f"({store.total_bytes() / 1e6:.1f} MB resident, "
+              f"cap {max_mb} MB)")
+        return 0
+    if args.cache_action == "clear":
+        removed = store.clear()
+        print(f"removed {removed} entries from {root}")
+        return 0
+    return 2
+
+
 def _cmd_checkpoint(args):
     from .resilience import inspect_dir
 
@@ -589,10 +652,15 @@ def _cmd_serve(args):
 
     import numpy as np
 
+    from . import flags
     from .core.places import CPUPlace, TPUPlace
     from .serve import ServeConfig, Server
     from .serve.http import serve_http
 
+    if args.cache_dir:
+        # persistent compile cache: bucket warmup deserializes executables
+        # another process already compiled (sub-second warm start)
+        flags.set("compile_cache_dir", args.cache_dir)
     place = CPUPlace() if args.place == "cpu" else TPUPlace(0)
     config = ServeConfig(
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
@@ -644,10 +712,15 @@ def _cmd_fleet_replica(args):
     import signal
     import threading
 
+    from . import flags
     from .core.places import CPUPlace, TPUPlace
     from .serve import ServeConfig, Server
     from .serve.http import make_http_server
 
+    if args.cache_dir:
+        # fleet spin-up: every replica shares one persistent compile
+        # cache, so only the first one ever compiles each bucket
+        flags.set("compile_cache_dir", args.cache_dir)
     if args.chaos_kill_at is not None or args.chaos_hang_at is not None:
         from .resilience import chaos
 
@@ -973,6 +1046,29 @@ def main(argv=None):
     hc.add_argument("--json", action="store_true",
                     help="emit the parity report as JSON")
 
+    ca = sub.add_parser("cache", help="persistent compile-cache store "
+                                      "(FLAGS_compile_cache_dir)")
+    casub = ca.add_subparsers(dest="cache_action", required=True)
+    cal = casub.add_parser("ls", help="list entries: digest, kind, bytes, "
+                                      "age, jaxlib version")
+    cal.add_argument("--dir", default=None,
+                     help="store directory (default "
+                          "FLAGS_compile_cache_dir)")
+    cal.add_argument("--json", action="store_true",
+                     help="emit the listing as JSON")
+    cap_ = casub.add_parser("prune", help="delete oldest-used entries "
+                                          "until the store fits the cap")
+    cap_.add_argument("--dir", default=None,
+                      help="store directory (default "
+                           "FLAGS_compile_cache_dir)")
+    cap_.add_argument("--max-mb", type=int, default=None,
+                      help="size cap in MiB (default "
+                           "FLAGS_compile_cache_dir_max_mb)")
+    cac = casub.add_parser("clear", help="delete every entry")
+    cac.add_argument("--dir", default=None,
+                     help="store directory (default "
+                          "FLAGS_compile_cache_dir)")
+
     c = sub.add_parser("checkpoint", help="inspect checkpoint directories")
     csub = c.add_subparsers(dest="checkpoint_action", required=True)
     ci = csub.add_parser("inspect", help="list serials, commit status and "
@@ -1090,6 +1186,10 @@ def main(argv=None):
     s.add_argument("--selftest", type=int, default=64, metavar="N",
                    help="without --http: fire N synthetic requests from "
                         "concurrent clients and print stats JSON")
+    s.add_argument("--cache-dir", default=None,
+                   help="persistent compile-cache directory "
+                        "(FLAGS_compile_cache_dir): warmup loads "
+                        "executables compiled by earlier processes")
 
     tr = sub.add_parser("trace", help="flight-recorder dumps and per-op "
                                       "cost attribution")
@@ -1151,6 +1251,10 @@ def main(argv=None):
                     help="hang this replica on its Nth executor dispatch")
     fr.add_argument("--chaos-hang-ms", type=float, default=None,
                     help="hang duration (default: effectively forever)")
+    fr.add_argument("--cache-dir", default=None,
+                    help="persistent compile-cache directory shared by "
+                         "the fleet (FLAGS_compile_cache_dir): only the "
+                         "first replica compiles, the rest deserialize")
     fo = fsub.add_parser("router", help="run the fleet router over a "
                                         "replica set")
     fo.add_argument("--replicas", default="",
@@ -1211,6 +1315,8 @@ def main(argv=None):
             return _cmd_monitor(args)
         if args.command == "health":
             return _cmd_health(args)
+        if args.command == "cache":
+            return _cmd_cache(args)
         if args.command == "checkpoint":
             return _cmd_checkpoint(args)
         if args.command == "shard":

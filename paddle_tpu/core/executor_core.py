@@ -388,7 +388,7 @@ def build_step_fn(program, fetch_names, state_out_names, is_test=False,
 
 
 def compile_step_fn(step, donate_state=True, donate_feeds=False,
-                    probe=None):
+                    probe=None, aot=None):
     """jit the step. donate_state aliases mut_state so parameters update in
     place; donate_feeds ALSO donates the feeds argument — correct only for
     single-use staged chunks (datapipe transfer engine marks them with
@@ -404,13 +404,25 @@ def compile_step_fn(step, donate_state=True, donate_feeds=False,
     the FIRST execution — the only point where the jitted fn and live
     (not-yet-donated) example args coexist, which is what
     monitor.compile_probe needs to lower for HLO cost analysis. Probe
-    failures never fail the step."""
+    failures never fail the step.
+
+    aot: optional callable(compiled_executable) — the persistent compile
+    cache's export hook. When set, the first call compiles ahead-of-time
+    (jit.lower(*args).compile()) instead of priming the lazy jit cache,
+    hands the executable to `aot` for serialization, and every later call
+    dispatches that executable directly (the lazy cache and the AOT path
+    do not share entries, so holding the Compiled is what makes the
+    export free). If lowering/AOT compilation fails the call falls back
+    to the lazy jit (no export); if a later call's avals drift from the
+    AOT signature (jax validates args BEFORE dispatch, so nothing has
+    been donated yet) the call retreats to the retracing jit for good."""
     donate = (0,) if donate_state else ()
-    if not donate_feeds and probe is None:
+    if not donate_feeds and probe is None and aot is None:
         return jax.jit(step, donate_argnums=donate)
     compiled = jax.jit(
         step, donate_argnums=donate + ((2,) if donate_feeds else ()))
     probed = [probe is None]
+    aot_exe = [None if aot is not None else False]  # False = lazy path
 
     def call(*args):
         import warnings
@@ -421,12 +433,36 @@ def compile_step_fn(step, donate_state=True, donate_feeds=False,
                 probe(compiled, args)
             except Exception:
                 pass
-        if not donate_feeds:
-            return compiled(*args)
-        with warnings.catch_warnings():
-            warnings.filterwarnings(
-                "ignore", message="Some donated buffers were not usable")
-            return compiled(*args)
+        if aot_exe[0] is None:
+            try:
+                with warnings.catch_warnings():
+                    if donate_feeds:
+                        warnings.filterwarnings(
+                            "ignore",
+                            message="Some donated buffers were not usable")
+                    exe = compiled.lower(*args).compile()
+            except Exception:
+                aot_exe[0] = False  # this step can't AOT; stay lazy
+            else:
+                aot_exe[0] = exe
+                try:
+                    aot(exe)
+                except Exception:
+                    pass  # a cache export must never fail the step
+        target = aot_exe[0] or compiled
+        try:
+            if not donate_feeds:
+                return target(*args)
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable")
+                return target(*args)
+        except (TypeError, ValueError):
+            if target is compiled:
+                raise
+            aot_exe[0] = False  # aval drift: the AOT signature is pinned
+            return call(*args)
 
     return call
 

@@ -17,6 +17,7 @@ from . import amp
 from . import analysis
 from . import flags
 from . import monitor
+from .cache import CompileCache
 from .core import executor_core, registry
 from .core.framework import Program, Variable, default_main_program
 from .core.lod_tensor import LoDTensor
@@ -256,7 +257,7 @@ class FetchFuture:
 class Executor:
     def __init__(self, place=None):
         self.place = place if place is not None else TPUPlace(0)
-        self._compile_cache = {}
+        self._compile_cache = CompileCache("executor")
         self._step_counter = {}
 
     def _device_scope(self):
@@ -266,9 +267,19 @@ class Executor:
         return jax.default_device(jax_device_for(self.place))
 
     def compile_cache_info(self):
-        """Compile-cache occupancy: {"entries": N}. The serving engine
-        diffs this across warmup to assert zero steady-state compiles."""
-        return {"entries": len(self._compile_cache)}
+        """Compile-cache stats: entries plus hit/miss/eviction counters and
+        the persistent-L2 counter family (cache.CompileCache.info). The
+        "entries" key is load-bearing — the serving engine diffs it across
+        warmup to assert zero steady-state compiles."""
+        return self._compile_cache.info()
+
+    def _l2_extra(self):
+        """Device context folded into the persistent-cache digest: a
+        serialized executable is bound to its device assignment, so a
+        different device takes a clean miss instead of a load failure."""
+        dev = jax_device_for(self.place)
+        return (("device", getattr(dev, "platform", "?"),
+                 int(getattr(dev, "id", -1))),)
 
     # ------------------------------------------------------------------
     def run(
@@ -429,17 +440,9 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _cache_store(self, cache_key, entry, mon=None):
-        """Insert a compile-cache entry, evicting the oldest entries when
-        FLAGS_compile_cache_cap bounds the cache (insertion order — the
-        dict preserves it). Evictions are a recompile-churn signal, so
-        each one is counted in the monitor registry."""
-        cap = flags.get("compile_cache_cap")
-        if cap and cap > 0:
-            while len(self._compile_cache) >= cap:
-                self._compile_cache.pop(next(iter(self._compile_cache)))
-                if mon is not None:
-                    monitor.cache_evicted(mon.kind)
-        self._compile_cache[cache_key] = entry
+        """Insert a compile-cache entry; cache.CompileCache owns the
+        FLAGS_compile_cache_cap true-LRU eviction and its counters."""
+        self._compile_cache.put(cache_key, entry, mon=mon)
 
     def _run_compiled(self, program, scope, feed, fetch_names, use_cache,
                       wire=None, donate_feeds=False, mon=None):
@@ -467,10 +470,9 @@ class Executor:
         )
         entry = self._compile_cache.get(cache_key) if use_cache else None
         fp = monitor.fingerprint_of(cache_key) if mon is not None else None
-        if mon is not None:
-            mon.mark_cache(entry is not None, fingerprint=fp)
         build_s = 0.0
         was_miss = entry is None
+        level = "l1" if entry is not None else None
         if entry is None:
             # FLAGS_verify: static checks ride the compile-cache MISS path
             # only (memoized per program+mutation+config), so the enabled
@@ -481,29 +483,54 @@ class Executor:
                 donate_state=not flags.get("debug_nans"),
                 context="executor")
             tb = time.perf_counter()
-            built_fetch = (list(fetch_names) + hplan.fetch_names
-                           if hplan is not None else fetch_names)
-            step = executor_core.build_step_fn(program, built_fetch, state_out_names)
-            if wire is not None:
-                step = wire.wrap_step(
-                    step, var_dtypes=self._wire_var_dtypes(program, wire))
-            if hplan is not None:
-                # fold the appended grad fetches into one [4]-stat leaf
-                # per param INSIDE the jit (health/stats.py)
-                step = hplan.wrap_step(step, len(fetch_names))
-            probe = monitor.compile_probe(fp) \
-                if mon is not None and flags.get("monitor_hlo_cost") else None
-            # under debug_nans the trap fires INSIDE compiled() before the
-            # scope write-back; donated buffers would already be deleted,
-            # wrecking both the scope and jax's op-by-op re-run — so trade
-            # the in-place update away while the sanitizer is on
-            compiled = executor_core.compile_step_fn(
-                step, donate_state=not flags.get("debug_nans"),
-                donate_feeds=donate_feeds, probe=probe)
+            cache_obj = self._compile_cache
+            digest = cache_obj.l2_digest(
+                program, cache_key[2:], extra=self._l2_extra()) \
+                if use_cache and cache_obj.l2_enabled() else None
+
+            def _fresh(export_digest=None):
+                built_fetch = (list(fetch_names) + hplan.fetch_names
+                               if hplan is not None else fetch_names)
+                step = executor_core.build_step_fn(
+                    program, built_fetch, state_out_names)
+                if wire is not None:
+                    step = wire.wrap_step(
+                        step,
+                        var_dtypes=self._wire_var_dtypes(program, wire))
+                if hplan is not None:
+                    # fold the appended grad fetches into one [4]-stat leaf
+                    # per param INSIDE the jit (health/stats.py)
+                    step = hplan.wrap_step(step, len(fetch_names))
+                probe = monitor.compile_probe(fp) \
+                    if mon is not None and flags.get("monitor_hlo_cost") \
+                    else None
+                # under debug_nans the trap fires INSIDE compiled() before
+                # the scope write-back; donated buffers would already be
+                # deleted, wrecking both the scope and jax's op-by-op
+                # re-run — so trade the in-place update away while the
+                # sanitizer is on
+                return executor_core.compile_step_fn(
+                    step, donate_state=not flags.get("debug_nans"),
+                    donate_feeds=donate_feeds, probe=probe,
+                    aot=cache_obj.aot_sink(export_digest))
+
+            loaded = cache_obj.l2_load(digest, mon=mon) \
+                if digest is not None else None
+            if loaded is not None:
+                # warm start: deserialized from FLAGS_compile_cache_dir
+                # instead of compiling; a first-call signature mismatch
+                # falls back to a fresh compile (guard_l2)
+                compiled = cache_obj.guard_l2(loaded, _fresh, mon=mon)
+                was_miss = False
+                level = "l2"
+            else:
+                compiled = _fresh(digest)
             build_s = time.perf_counter() - tb
             entry = (compiled, state_names, state_out_names)
             if use_cache:
                 self._cache_store(cache_key, entry, mon=mon)
+        if mon is not None:
+            mon.mark_cache(not was_miss, fingerprint=fp, level=level)
         compiled, state_names, state_out_names = entry
 
         mut_state = {}
@@ -531,6 +558,10 @@ class Executor:
                 mon.phase("compile", build_s + call_s)
                 monitor.record_compile(fp, wall_s=build_s + call_s)
                 _trace_costs.register_program(fp, program)
+            elif level == "l2":
+                # warm start: deserialize wall time, no XLA compile
+                mon.phase("cache_load", build_s)
+                mon.phase("dispatch", call_s)
             else:
                 mon.phase("dispatch", call_s)  # enqueue time (async)
         # write back BEFORE any nan check can raise: mut_state was donated,
@@ -628,10 +659,9 @@ class Executor:
 
         entry = self._compile_cache.get(cache_key) if use_cache else None
         fp = monitor.fingerprint_of(cache_key) if mon is not None else None
-        if mon is not None:
-            mon.mark_cache(entry is not None, fingerprint=fp)
         build_s = 0.0
         was_miss = entry is None
+        level = "l1" if entry is not None else None
         if entry is None:
             analysis.ensure_verified(
                 program, feed_names=list(feed_vals),
@@ -639,38 +669,64 @@ class Executor:
                 donate_state=not flags.get("debug_nans"),
                 context="executor")
             tb = time.perf_counter()
-            built_fetch = (list(fetch_names) + hplan.fetch_names
-                           if hplan is not None else fetch_names)
-            step = executor_core.build_step_fn(
-                program, built_fetch, state_out_names)
-            if wire is not None:
-                # decode INSIDE the per-step fn: the scan slices the compact
-                # [K, ...] wire chunk and each iteration casts/scales only
-                # its own step's slice — the full-width tensor never exists
-                # as [K, ...] in device memory
-                step = wire.wrap_step(
-                    step, var_dtypes=self._wire_var_dtypes(program, wire))
-            if hplan is not None:
-                # reduce the appended grad fetches to [4]-stat leaves per
-                # step BEFORE the scan wraps them — the scan then stacks
-                # tiny stats, never raw [K, ...] gradients
-                step = hplan.wrap_step(step, len(fetch_names))
+            # ema folding and the pack plan are cheap host-side analyses
+            # needed on BOTH the fresh-compile and the L2-hit paths (the
+            # pack/unpack around the dispatch mirrors what the serialized
+            # executable was compiled against — both are derived
+            # deterministically from the program + state, and the flags
+            # gating them are part of the digest)
             ema = executor_core.collect_ema_states(
                 program, state_out_names, fetch_names) \
                 if flags.get("fold_ema_multi_step") else {}
             plan = None
             if flags.get("pack_small_state"):
                 plan = executor_core.PackPlan(mut_state, exclude=set(ema))
-                if plan.groups:
-                    step = plan.wrap_step(step)
-                else:
+                if not plan.groups:
                     plan = None
-            multi = executor_core.build_multi_step_fn(step, iters, ema=ema)
-            probe = monitor.compile_probe(fp) \
-                if mon is not None and flags.get("monitor_hlo_cost") else None
-            compiled = executor_core.compile_step_fn(
-                multi, donate_state=not flags.get("debug_nans"),
-                donate_feeds=donate_feeds, probe=probe)
+            cache_obj = self._compile_cache
+            digest = cache_obj.l2_digest(
+                program, cache_key[2:], extra=self._l2_extra()) \
+                if use_cache and cache_obj.l2_enabled() else None
+
+            def _fresh(export_digest=None):
+                built_fetch = (list(fetch_names) + hplan.fetch_names
+                               if hplan is not None else fetch_names)
+                step = executor_core.build_step_fn(
+                    program, built_fetch, state_out_names)
+                if wire is not None:
+                    # decode INSIDE the per-step fn: the scan slices the
+                    # compact [K, ...] wire chunk and each iteration
+                    # casts/scales only its own step's slice — the
+                    # full-width tensor never exists as [K, ...] in device
+                    # memory
+                    step = wire.wrap_step(
+                        step,
+                        var_dtypes=self._wire_var_dtypes(program, wire))
+                if hplan is not None:
+                    # reduce the appended grad fetches to [4]-stat leaves
+                    # per step BEFORE the scan wraps them — the scan then
+                    # stacks tiny stats, never raw [K, ...] gradients
+                    step = hplan.wrap_step(step, len(fetch_names))
+                if plan is not None:
+                    step = plan.wrap_step(step)
+                multi = executor_core.build_multi_step_fn(step, iters,
+                                                          ema=ema)
+                probe = monitor.compile_probe(fp) \
+                    if mon is not None and flags.get("monitor_hlo_cost") \
+                    else None
+                return executor_core.compile_step_fn(
+                    multi, donate_state=not flags.get("debug_nans"),
+                    donate_feeds=donate_feeds, probe=probe,
+                    aot=cache_obj.aot_sink(export_digest))
+
+            loaded = cache_obj.l2_load(digest, mon=mon) \
+                if digest is not None else None
+            if loaded is not None:
+                compiled = cache_obj.guard_l2(loaded, _fresh, mon=mon)
+                was_miss = False
+                level = "l2"
+            else:
+                compiled = _fresh(digest)
             unpackers = {}
             if plan is not None:
                 for g in plan.groups:
@@ -682,6 +738,8 @@ class Executor:
                      unpackers, {})
             if use_cache:
                 self._cache_store(cache_key, entry, mon=mon)
+        if mon is not None:
+            mon.mark_cache(not was_miss, fingerprint=fp, level=level)
         compiled, state_names, state_out_names, plan, unpackers, memo = entry
 
         if plan is not None:
@@ -733,6 +791,9 @@ class Executor:
                 mon.phase("compile", build_s + call_s)
                 monitor.record_compile(fp, wall_s=build_s + call_s)
                 _trace_costs.register_program(fp, program)
+            elif level == "l2":
+                mon.phase("cache_load", build_s)
+                mon.phase("dispatch", call_s)
             else:
                 mon.phase("dispatch", call_s)
         if plan is not None:

@@ -15,6 +15,11 @@ Schema (one object per line; optional fields omitted when absent):
   phases_ms     {"feed_encode": .., "compile": .., "dispatch": ..,
                  "fetch_readback": ..}  (phases that occurred this step)
   cache         "hit" | "miss"  (compile-cache outcome)
+  cache_level   "l1" | "l2" on hits — "l2" is a persistent warm start
+                (executable deserialized from FLAGS_compile_cache_dir)
+  cache_evictions    L1 entries evicted by FLAGS_compile_cache_cap
+  cache_l2_fallback  reason string when a persistent entry was corrupt/
+                     stale/undeserializable and the step recompiled
   fingerprint   8-hex id of the compile-cache key (joins compile_info)
   datapipe      per-stage delta stats when the step pulled from a DataPipe
   wire          {feed: wire-format repr} when a WireSpec rode the chunk
@@ -149,10 +154,20 @@ def summarize_journal(records):
         for name, ms in (r.get("phases_ms") or {}).items():
             phases.setdefault(name, []).append(float(ms))
     cache = {"hit": 0, "miss": 0}
+    hit_l2 = 0
+    evictions = 0
+    l2_fallbacks = 0
     for r in records:
         c = r.get("cache")
         if c in cache:
             cache[c] += 1
+        if c == "hit" and r.get("cache_level") == "l2":
+            hit_l2 += 1
+        evictions += int(r.get("cache_evictions") or 0)
+        if r.get("cache_l2_fallback"):
+            l2_fallbacks += 1
+    if hit_l2:
+        cache["hit_l2"] = hit_l2
     skews = [r["skew"]["max_over_median"] for r in records
              if isinstance(r.get("skew"), dict)
              and r["skew"].get("max_over_median") is not None]
@@ -174,6 +189,8 @@ def summarize_journal(records):
             n: sum(v) / len(v) for n, v in sorted(phases.items())
         },
         "cache": cache,
+        "cache_evictions": evictions,
+        "cache_l2_fallbacks": l2_fallbacks,
     }
     if skews:
         out["skew_max_over_median"] = {
@@ -214,7 +231,16 @@ def format_summary(summary):
                            key=lambda kv: -kv[1]):
             lines.append(f"{n:<16}{v:>12.3f}{v / total:>8.1%}")
     c = summary["cache"]
-    lines.append(f"compile cache: {c['hit']} hits / {c['miss']} misses")
+    line = f"compile cache: {c['hit']} hits / {c['miss']} misses"
+    if c.get("hit_l2"):
+        line += f" ({c['hit_l2']} persistent warm starts)"
+    ev = summary.get("cache_evictions") or 0
+    if ev:
+        line += f", {ev} evictions"
+    fb = summary.get("cache_l2_fallbacks") or 0
+    if fb:
+        line += f", {fb} L2 fallbacks"
+    lines.append(line)
     if "skew_max_over_median" in summary:
         s = summary["skew_max_over_median"]
         lines.append(

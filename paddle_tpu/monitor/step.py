@@ -35,7 +35,7 @@ from .skew import replica_skew
 __all__ = ["StepRecord", "enabled", "registry", "exposition", "reset",
            "step_begin", "step_end", "last_step", "compile_info",
            "record_compile", "compile_probe", "fingerprint_of",
-           "cache_evicted", "steps_done", "restore_steps"]
+           "cache_evicted", "cache_l2", "steps_done", "restore_steps"]
 
 flags.define(
     "monitor_hlo_cost", bool, False,
@@ -105,14 +105,15 @@ def reset():
 class StepRecord:
     """Accumulates one step's phases; built only when monitoring is on."""
 
-    __slots__ = ("kind", "t0", "phases", "cache", "fingerprint", "extra",
-                 "intervals")
+    __slots__ = ("kind", "t0", "phases", "cache", "cache_level",
+                 "fingerprint", "extra", "intervals")
 
     def __init__(self, kind):
         self.kind = kind
         self.t0 = time.perf_counter()
-        self.phases = {}     # name -> seconds
-        self.cache = None    # "hit" | "miss"
+        self.phases = {}        # name -> seconds
+        self.cache = None       # "hit" | "miss"
+        self.cache_level = None  # "l1" | "l2" on a hit (l2 = warm start)
         self.fingerprint = None
         self.extra = None    # journal-only extras
         self.intervals = []  # (name, t0, t1) per occurrence — the phase
@@ -137,8 +138,13 @@ class StepRecord:
             t1 = time.perf_counter()
             self.phase(name, t1 - t0, interval=(t0, t1))
 
-    def mark_cache(self, hit, fingerprint=None):
+    def mark_cache(self, hit, fingerprint=None, level=None):
+        """level: "l1" (in-process) or "l2" (deserialized from the
+        persistent store) on a hit. A warm-started process therefore
+        reports compile_cache_misses == 0 — the contract bench.py and
+        green_gate assert against FLAGS_compile_cache_dir."""
         self.cache = "hit" if hit else "miss"
+        self.cache_level = level if hit else None
         self.fingerprint = fingerprint
         _registry.counter(
             "compile_cache_hits_total" if hit else
@@ -220,6 +226,26 @@ def cache_evicted(kind="executor"):
                       cache=kind).inc()
 
 
+_L2_HELP = {
+    "hits": "persistent compile-cache loads (warm starts)",
+    "misses": "persistent compile-cache lookups with no entry",
+    "fallbacks": "corrupt/stale/unloadable persistent entries "
+                 "recompiled over",
+    "puts": "executables serialized into the persistent store",
+    "put_bytes": "bytes written to the persistent store",
+}
+
+
+def cache_l2(kind, which, n=1):
+    """Count one persistent (L2) compile-cache event:
+    compile_cache_l2_<which>_total{cache=kind}. Callers (paddle_tpu.cache)
+    gate on enabled() so FLAGS_monitor=0 keeps the registry untouched."""
+    _registry.counter(
+        f"compile_cache_l2_{which}_total",
+        help=_L2_HELP.get(which, "persistent compile-cache events"),
+        cache=kind).inc(n)
+
+
 def _journal_writer():
     path = flags.get("monitor_journal")
     if not path:
@@ -273,6 +299,8 @@ def step_end(rec, iters=None, datapipe=None, replica_ms=None,
     if rec.cache is not None:
         record["cache"] = rec.cache
         record["fingerprint"] = rec.fingerprint
+        if rec.cache_level is not None:
+            record["cache_level"] = rec.cache_level
     if rec.extra:
         record.update(rec.extra)
 

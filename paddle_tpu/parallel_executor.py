@@ -38,6 +38,7 @@ from . import amp
 from . import analysis
 from . import flags
 from . import monitor
+from .cache import CompileCache
 from .core import executor_core
 from .core.framework import Parameter, Variable, default_main_program
 from .core.lod_tensor import LoDTensor
@@ -139,7 +140,7 @@ class ParallelExecutor:
                 tuple(n for n, _ in axes))
         else:
             self._mesh = Mesh(np.array(self._devices), ("dp",))
-        self._compile_cache = {}
+        self._compile_cache = CompileCache("parallel_executor")
         # zero1/grad-scale rewritten program clones, keyed on the source
         # program identity + mutation counter; strong refs keep id() stable
         # for the compile cache
@@ -159,9 +160,30 @@ class ParallelExecutor:
         return len(self._devices)
 
     def compile_cache_info(self):
-        """Compile-cache occupancy: {"entries": N}. The serving engine
-        diffs this across warmup to assert zero steady-state compiles."""
-        return {"entries": len(self._compile_cache)}
+        """Compile-cache stats: entries plus hit/miss/eviction counters and
+        the persistent-L2 counter family (cache.CompileCache.info). The
+        "entries" key is load-bearing — the serving engine diffs it across
+        warmup to assert zero steady-state compiles."""
+        return self._compile_cache.info()
+
+    def _l2_extra(self):
+        """Mesh/device context folded into the persistent-cache digest: a
+        serialized executable is bound to its device assignment, so an
+        elastic resize (different mesh geometry or device set) takes a
+        clean miss instead of a deserialize-time failure."""
+        return (
+            ("mesh", tuple((str(k), int(v))
+                           for k, v in self._mesh.shape.items())),
+            ("devices", tuple(
+                (getattr(d, "platform", "?"), int(getattr(d, "id", -1)))
+                for d in self._devices)),
+            ("procs", int(jax.process_count()), int(jax.process_index())),
+        )
+
+    def _cache_store(self, cache_key, entry, mon=None):
+        """Insert a compile-cache entry; cache.CompileCache owns the
+        FLAGS_compile_cache_cap true-LRU eviction and its counters."""
+        self._compile_cache.put(cache_key, entry, mon=mon)
 
     # ------------------------------------------------------------------
     def _prepare_program(self, program, use_zero1, gss, dp_n):
@@ -503,10 +525,9 @@ class ParallelExecutor:
         )
         entry = self._compile_cache.get(cache_key)
         fp = monitor.fingerprint_of(cache_key) if mon is not None else None
-        if mon is not None:
-            mon.mark_cache(entry is not None, fingerprint=fp)
         build_s = 0.0
         was_miss = entry is None
+        level = "l1" if entry is not None else None
         if entry is None:
             # FLAGS_verify on the MISS path only, with the mesh and the
             # zero1/autoshard plans in scope so the `full` level can run
@@ -520,29 +541,6 @@ class ParallelExecutor:
                 donate_state=not flags.get("debug_nans"),
                 context="parallel_executor")
             tb = time.perf_counter()
-            constraints = None
-            if aplan is not None:
-                constraints = {
-                    n: NamedSharding(self._mesh, P(*s))
-                    for n, s in aplan.boundary_specs().items()}
-            built_fetch = (list(fetch_names) + hplan.fetch_names
-                           if hplan is not None else fetch_names)
-            step = executor_core.build_step_fn(
-                program, built_fetch, state_out_names,
-                constraints=constraints)
-            if wire is not None:
-                # decode in the PER-STEP fn (before the scan wrapper), so
-                # each iteration widens only its own [batch, ...] slice
-                gb = program.global_block()
-                var_dtypes = {
-                    n: gb.vars[n].dtype for n in wire
-                    if n in gb.vars and gb.vars[n].dtype is not None}
-                step = wire.wrap_step(step, var_dtypes=var_dtypes)
-            if hplan is not None:
-                # per-step stats reduction before any scan wrapper, so a
-                # K-step scan stacks [4]-stat leaves, not raw grads; GSPMD
-                # lowers the reductions shard-locally under the mesh
-                step = hplan.wrap_step(step, len(fetch_names))
             if iters is not None:
                 missing = [n for n in state_out_names
                            if not scope.has_var(n)]
@@ -551,21 +549,62 @@ class ParallelExecutor:
                         f"iters > 1 needs every written persistable var in "
                         f"scope before the scan; missing: {missing}. Run "
                         f"the startup program first.")
-                step = executor_core.build_multi_step_fn(step, iters)
-            probe = monitor.compile_probe(fp) \
-                if mon is not None and flags.get("monitor_hlo_cost") else None
-            compiled = executor_core.compile_step_fn(
-                step, donate_state=not flags.get("debug_nans"),
-                donate_feeds=donate_feeds, probe=probe)
+            cache_obj = self._compile_cache
+            digest = cache_obj.l2_digest(
+                program, cache_key[2:], extra=self._l2_extra()) \
+                if cache_obj.l2_enabled() else None
+
+            def _fresh(export_digest=None):
+                constraints = None
+                if aplan is not None:
+                    constraints = {
+                        n: NamedSharding(self._mesh, P(*s))
+                        for n, s in aplan.boundary_specs().items()}
+                built_fetch = (list(fetch_names) + hplan.fetch_names
+                               if hplan is not None else fetch_names)
+                step = executor_core.build_step_fn(
+                    program, built_fetch, state_out_names,
+                    constraints=constraints)
+                if wire is not None:
+                    # decode in the PER-STEP fn (before the scan wrapper),
+                    # so each iteration widens only its own [batch] slice
+                    gb = program.global_block()
+                    var_dtypes = {
+                        n: gb.vars[n].dtype for n in wire
+                        if n in gb.vars and gb.vars[n].dtype is not None}
+                    step = wire.wrap_step(step, var_dtypes=var_dtypes)
+                if hplan is not None:
+                    # per-step stats reduction before any scan wrapper, so
+                    # a K-step scan stacks [4]-stat leaves, not raw grads;
+                    # GSPMD lowers the reductions shard-locally on the mesh
+                    step = hplan.wrap_step(step, len(fetch_names))
+                if iters is not None:
+                    step = executor_core.build_multi_step_fn(step, iters)
+                probe = monitor.compile_probe(fp) \
+                    if mon is not None and flags.get("monitor_hlo_cost") \
+                    else None
+                return executor_core.compile_step_fn(
+                    step, donate_state=not flags.get("debug_nans"),
+                    donate_feeds=donate_feeds, probe=probe,
+                    aot=cache_obj.aot_sink(export_digest))
+
+            loaded = cache_obj.l2_load(digest, mon=mon) \
+                if digest is not None else None
+            if loaded is not None:
+                # warm start (fleet replica spin-up, resilience restore,
+                # elastic re-join): deserialized from the shared
+                # FLAGS_compile_cache_dir instead of compiling; a
+                # first-call signature mismatch rebuilds fresh (guard_l2)
+                compiled = cache_obj.guard_l2(loaded, _fresh, mon=mon)
+                was_miss = False
+                level = "l2"
+            else:
+                compiled = _fresh(digest)
             build_s = time.perf_counter() - tb
             entry = (compiled, state_names, state_out_names)
-            cap = flags.get("compile_cache_cap")
-            if cap and cap > 0:
-                while len(self._compile_cache) >= cap:
-                    self._compile_cache.pop(next(iter(self._compile_cache)))
-                    if mon is not None:
-                        monitor.cache_evicted(mon.kind)
-            self._compile_cache[cache_key] = entry
+            self._cache_store(cache_key, entry, mon=mon)
+        if mon is not None:
+            mon.mark_cache(not was_miss, fingerprint=fp, level=level)
         compiled, state_names, state_out_names = entry
 
         multiproc = any(
@@ -648,6 +687,10 @@ class ParallelExecutor:
                 mon.phase("compile", build_s + call_s)
                 monitor.record_compile(fp, wall_s=build_s + call_s)
                 _trace_costs.register_program(fp, program)
+            elif level == "l2":
+                # warm start: deserialize wall time, no XLA compile
+                mon.phase("cache_load", build_s)
+                mon.phase("dispatch", call_s)
             else:
                 mon.phase("dispatch", call_s)
         for n, v in new_mut.items():

@@ -1,0 +1,413 @@
+"""paddle_tpu.cache: the pluggable two-level compile cache.
+
+L1 true-LRU semantics (a hot entry survives the cap), process-stable L2
+digests (proven across subprocesses with different PYTHONHASHSEEDs), the
+warm-start zero-miss contract, corrupt/stale entries that fall back to a
+fresh compile — counted, never raised — store maintenance (prune/clear),
+the `paddle_tpu cache` CLI, and the monitor-summary rendering.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags, monitor
+from paddle_tpu.cache import (CompileCache, L2Store, program_digest,
+                              serialize_support, stable_digest)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_serialize = pytest.mark.skipif(
+    serialize_support() is None,
+    reason="this jax build ships no serialize_executable")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_monitor():
+    monitor.reset()
+    yield
+    monitor.reset()
+
+
+def _flip_tail(path, n=8):
+    """Corrupt an entry's PAYLOAD in place. The tail is always payload:
+    the header JSON sits at the front of the file, and a flipped byte
+    inside one of its hex strings still parses — the payload checksum is
+    the integrity boundary, so that corruption is undetectable by design."""
+    with open(path, "r+b") as f:
+        f.seek(-n, 2)
+        tail = f.read(n)
+        f.seek(-n, 2)
+        f.write(bytes(b ^ 0xFF for b in tail))
+
+
+# ---------------------------------------------------------------------------
+# L1: true LRU under FLAGS_compile_cache_cap
+# ---------------------------------------------------------------------------
+
+def test_l1_hot_entry_survives_cap_eviction():
+    # the regression the refactor fixes: the old per-executor dicts popped
+    # INSERTION order at the cap, evicting the hottest entry first
+    cc = CompileCache("executor")
+    with flags.flag_guard(compile_cache_cap=2):
+        cc.put("a", 1)
+        cc.put("b", 2)
+        assert cc.get("a") == 1  # refresh a's recency
+        cc.put("c", 3)  # must evict b (least recently USED), not a
+    assert "a" in cc and "c" in cc and "b" not in cc
+    assert cc.evictions == 1
+    assert cc.info()["evictions"] == 1
+
+
+def test_l1_reput_of_resident_key_at_cap_evicts_nothing():
+    cc = CompileCache()
+    with flags.flag_guard(compile_cache_cap=2):
+        cc.put("a", 1)
+        cc.put("b", 2)
+        cc.put("a", 10)  # refresh, not insert: no room needed
+    assert cc.evictions == 0
+    assert cc["a"] == 10 and "b" in cc
+
+
+def test_l1_counters_and_mapping_surface():
+    cc = CompileCache()
+    assert cc.get("missing") is None
+    cc.put("k", "v")
+    assert cc.get("k") == "v"
+    assert len(cc) == 1 and list(cc) == ["k"] and cc["k"] == "v"
+    assert "k" in cc and list(cc.items()) == [("k", "v")]
+    info = cc.info()
+    assert info["entries"] == 1
+    assert info["hits"] == 1 and info["misses"] == 1
+    cc.clear()
+    assert len(cc) == 0
+
+
+# ---------------------------------------------------------------------------
+# L2 digests: content-addressed, process-stable
+# ---------------------------------------------------------------------------
+
+def _mlp():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.fc(input=x, size=4))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_program_digest_is_content_addressed():
+    m1, _, _ = _mlp()
+    m2, _, _ = _mlp()
+    assert m1 is not m2
+    assert program_digest(m1) == program_digest(m2)
+    # a mutation bump with UNCHANGED content keeps the digest (the memo is
+    # keyed on mutation, the digest on content)
+    m1._mutation += 1
+    assert program_digest(m1) == program_digest(m2)
+    # different content -> different digest
+    m3, s3 = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(m3, s3):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        fluid.layers.mean(fluid.layers.fc(input=x, size=5))
+    assert program_digest(m3) != program_digest(m1)
+
+
+def test_stable_digest_sensitive_to_tail_and_extra():
+    m, _, _ = _mlp()
+    base = stable_digest(m, (("amp-off",),))
+    assert base == stable_digest(m, (("amp-off",),))
+    assert base != stable_digest(m, (("amp", "bfloat16"),))
+    assert base != stable_digest(m, (("amp-off",),),
+                                 extra=(("kind", "parallel_executor"),))
+
+
+_CHILD = """
+import json, os
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import flags, monitor
+
+flags.set("monitor", True)
+monitor.reset()
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    loss = fluid.layers.mean(fluid.layers.fc(input=x, size=4))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(startup)
+out, = exe.run(main, feed={"x": np.ones((4, 8), "float32")},
+               fetch_list=[loss])
+snap = monitor.registry().snapshot()
+root = os.environ["FLAGS_compile_cache_dir"]
+print(json.dumps({
+    "digests": sorted(f[:-4] for f in os.listdir(root)
+                      if f.endswith(".aot")),
+    "misses": sum(v for k, v in snap.items()
+                  if "compile_cache_misses_total" in k),
+    "info": exe.compile_cache_info(),
+    "loss": float(np.asarray(out).reshape(-1)[0]),
+}))
+"""
+
+
+@needs_serialize
+def test_digest_and_warm_start_stable_across_processes(tmp_path):
+    """The two cross-process contracts at once: the same program in two
+    processes (with DIFFERENT hash seeds — nothing in the key may lean on
+    hash()) lands on the same L2 keys, and the second process compiles
+    NOTHING (monitor misses == 0, every executable deserialized)."""
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+
+    def run(hashseed):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONHASHSEED"] = hashseed
+        env["PYTHONPATH"] = REPO
+        env["FLAGS_compile_cache_dir"] = str(tmp_path / "store")
+        proc = subprocess.run(
+            [sys.executable, str(script)], env=env, cwd=REPO,
+            capture_output=True, text=True, timeout=240)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    cold = run("1")
+    warm = run("2")
+    assert cold["digests"], cold
+    assert cold["digests"] == warm["digests"]
+    assert cold["misses"] >= 1
+    assert cold["info"]["l2"]["puts"] >= 1
+    assert warm["misses"] == 0, warm
+    assert warm["info"]["l2"]["hits"] >= 1, warm
+    assert warm["loss"] == cold["loss"]
+
+
+@needs_serialize
+def test_flag_flip_changes_l2_key(tmp_path):
+    """A config that changes the compiled step (amp here; zero1/autoshard/
+    overlap ride the same key tail on the ParallelExecutor) must land on a
+    NEW L2 digest, never reuse the stale executable."""
+    from paddle_tpu import amp
+
+    main, startup, loss = _mlp()
+    feed = {"x": np.ones((4, 8), np.float32)}
+    scope = fluid.Scope()
+    with flags.flag_guard(compile_cache_dir=str(tmp_path)), \
+            fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        before = {f for f in os.listdir(tmp_path) if f.endswith(".aot")}
+        with amp.auto_cast():
+            exe.run(main, feed=feed, fetch_list=[loss])
+        after = {f for f in os.listdir(tmp_path) if f.endswith(".aot")}
+    assert before
+    assert after > before, (before, after)
+
+
+@needs_serialize
+def test_zero1_flag_flips_parallel_executor_l2_key(tmp_path):
+    from paddle_tpu.parallel_executor import BuildStrategy, ParallelExecutor
+
+    xs = np.random.RandomState(0).randn(8, 4).astype("float32")
+
+    def run_once(sharded):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            loss = fluid.layers.mean(fluid.layers.fc(input=x, size=3))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            main.random_seed = startup.random_seed = 7
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            fluid.Executor(fluid.CPUPlace()).run(startup)
+            bs = BuildStrategy()
+            bs.sharded_weight_update = sharded
+            pe = ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                  main_program=main, build_strategy=bs)
+            out, = pe.run([loss], feed={"x": xs})
+        return float(np.asarray(out).reshape(-1)[0])
+
+    with flags.flag_guard(compile_cache_dir=str(tmp_path)):
+        run_once(False)
+        plain = {f for f in os.listdir(tmp_path) if f.endswith(".aot")}
+        run_once(True)
+        sharded = {f for f in os.listdir(tmp_path) if f.endswith(".aot")}
+    assert plain
+    assert sharded > plain, (plain, sharded)
+
+
+# ---------------------------------------------------------------------------
+# fallbacks: corrupt / stale entries recompile, never raise
+# ---------------------------------------------------------------------------
+
+@needs_serialize
+def test_corrupt_entry_falls_back_and_self_heals(tmp_path):
+    main, startup, loss = _mlp()
+    feed = {"x": np.ones((4, 8), np.float32)}
+    scope = fluid.Scope()
+    with flags.flag_guard(compile_cache_dir=str(tmp_path), monitor=True), \
+            fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        paths = [os.path.join(tmp_path, f) for f in os.listdir(tmp_path)
+                 if f.endswith(".aot")]
+        assert paths and exe.compile_cache_info()["l2"]["puts"] >= 1
+        for p in paths:
+            _flip_tail(p)
+        # force the L1 miss -> L2 path a restarted process would take
+        exe._compile_cache.clear()
+        out2, = exe.run(main, feed=feed, fetch_list=[loss])
+        info = exe.compile_cache_info()
+        snap = monitor.registry().snapshot()
+    assert np.isfinite(np.asarray(out2)).all()  # recompiled, ran clean
+    assert info["l2"]["fallbacks"] >= 1, info
+    assert sum(v for k, v in snap.items()
+               if "compile_cache_l2_fallbacks_total" in k) >= 1, snap
+    # self-heal: the recompile re-put a valid entry over the corrupt one
+    store = L2Store(str(tmp_path))
+    assert any(store.get(e["digest"])[0] == "hit" for e in store.entries())
+
+
+def test_store_version_mismatch_is_stale(tmp_path, monkeypatch):
+    store = L2Store(str(tmp_path))
+    digest = "d" * 64
+    store.put(digest, b"payload-bytes")
+    assert store.get(digest)[0] == "hit"
+    import paddle_tpu.cache.store as store_mod
+
+    monkeypatch.setattr(store_mod, "environment",
+                        lambda: ("other-jax", "other-jaxlib", "cpu"))
+    outcome, payload, header = store.get(digest)
+    assert outcome == "stale"
+    assert payload is None
+    assert header["jax"] != "other-jax"  # the REAL header survives for ls
+
+
+def test_store_corrupt_truncated_garbage_and_miss(tmp_path):
+    store = L2Store(str(tmp_path))
+    digest = "a" * 64
+    store.put(digest, b"x" * 100)
+    path = store.path_for(digest)
+    _flip_tail(path, 4)  # payload bit-flip -> checksum mismatch
+    assert store.get(digest)[0] == "corrupt"
+    with open(path, "rb") as f:
+        blob = f.read()
+    with open(path, "wb") as f:
+        f.write(blob[:len(blob) // 2])  # torn write
+    assert store.get(digest)[0] == "corrupt"
+    with open(path, "wb") as f:
+        f.write(b"not a cache entry")  # foreign debris
+    assert store.get(digest)[0] == "corrupt"
+    ents = store.entries()
+    assert len(ents) == 1 and ents[0]["ok"] is False  # ls surfaces debris
+    assert store.get("b" * 64)[0] == "miss"
+
+
+def test_store_prune_is_mtime_lru_and_clear_empties(tmp_path):
+    store = L2Store(str(tmp_path))
+    for i, digest in enumerate(("a" * 64, "b" * 64, "c" * 64)):
+        store.put(digest, bytes(100))
+        os.utime(store.path_for(digest), (i, i))  # a oldest, c newest
+    total = store.total_bytes()
+    removed = store.prune(total - 1)
+    assert removed == 1
+    assert not os.path.exists(store.path_for("a" * 64))  # oldest went
+    assert os.path.exists(store.path_for("c" * 64))
+    assert store.prune(total) == 0  # already under the cap
+    assert store.clear() == 2
+    assert store.entries() == [] and store.total_bytes() == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI: paddle_tpu cache ls | prune | clear
+# ---------------------------------------------------------------------------
+
+def test_cache_cli_ls_prune_clear(tmp_path, capsys):
+    from paddle_tpu.cli import main as cli_main
+
+    with flags.flag_guard(compile_cache_dir=""):
+        assert cli_main(["cache", "ls"]) == 2  # no dir anywhere
+    assert cli_main(["cache", "ls",
+                     "--dir", str(tmp_path / "missing")]) == 2
+    capsys.readouterr()
+
+    store = L2Store(str(tmp_path))
+    store.put("e" * 64, b"z" * 64, kind="executor")
+    assert cli_main(["cache", "ls", "--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "e" * 16 in out and "executor" in out and "ok" in out
+
+    assert cli_main(["cache", "ls", "--dir", str(tmp_path),
+                     "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["dir"] == str(tmp_path)
+    assert data["total_bytes"] > 0
+    assert data["entries"][0]["digest"] == "e" * 64
+    assert data["entries"][0]["ok"] is True
+
+    assert cli_main(["cache", "prune", "--dir", str(tmp_path),
+                     "--max-mb", "1"]) == 0  # under the cap: keeps all
+    assert os.path.exists(store.path_for("e" * 64))
+    assert cli_main(["cache", "clear", "--dir", str(tmp_path)]) == 0
+    assert store.entries() == []
+    # the flag works as the default --dir
+    with flags.flag_guard(compile_cache_dir=str(tmp_path)):
+        assert cli_main(["cache", "ls"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# monitor surface
+# ---------------------------------------------------------------------------
+
+def test_journal_summary_renders_l2_outcomes():
+    records = [
+        {"total_ms": 1.0, "cache": "miss", "cache_l2_fallback": "corrupt"},
+        {"total_ms": 1.0, "cache": "hit", "cache_level": "l2"},
+        {"total_ms": 1.0, "cache": "hit", "cache_level": "l1",
+         "cache_evictions": 2},
+    ]
+    summary = monitor.summarize_journal(records)
+    assert summary["cache"] == {"hit": 2, "miss": 1, "hit_l2": 1}
+    assert summary["cache_evictions"] == 2
+    assert summary["cache_l2_fallbacks"] == 1
+    text = monitor.format_summary(summary)
+    assert "2 hits / 1 misses" in text
+    assert "1 persistent warm starts" in text
+    assert "2 evictions" in text
+    assert "1 L2 fallbacks" in text
+
+
+@needs_serialize
+def test_l2_hit_journals_as_hit_with_cache_load_phase(tmp_path):
+    """An L2 warm start is a cache HIT in the journal (level "l2") with
+    the deserialize time attributed to a cache_load phase, not compile."""
+    main, startup, loss = _mlp()
+    feed = {"x": np.ones((4, 8), np.float32)}
+    journal = tmp_path / "journal.jsonl"
+    scope = fluid.Scope()
+    with flags.flag_guard(compile_cache_dir=str(tmp_path / "store"),
+                          monitor=True,
+                          monitor_journal=str(journal)), \
+            fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        exe._compile_cache.clear()  # simulate the fresh-process L1 miss
+        exe.run(main, feed=feed, fetch_list=[loss])
+    records = monitor.read_journal(str(journal))
+    cold = records[-2]
+    warm = records[-1]
+    assert cold["cache"] == "miss" and "compile" in cold["phases_ms"]
+    assert warm["cache"] == "hit", warm
+    assert warm.get("cache_level") == "l2", warm
+    assert "cache_load" in warm["phases_ms"], warm
+    assert "compile" not in warm["phases_ms"], warm

@@ -306,10 +306,81 @@ h = result.get("health")
 assert h is not None, result
 assert h["off_delta_ok"], h
 assert h["on_overhead_ok"], h
+# persistent AOT cache: the warm child (same cache dir, new process) must
+# compile nothing, match the cold first loss bitwise, and have loaded
+# every executable from the L2 store the cold child populated
+cp = result.get("cache_persist")
+assert cp is not None, result.get("cache_persist_error", result)
+assert cp["warm_misses"] == 0, cp
+assert cp["loss_parity"], cp
+assert cp["l2_puts"] >= 1 and cp["warm_l2_hits"] >= 1, cp
 print("bench --dry: ok")
 '
 if [ $? -ne 0 ]; then
     echo "GATE: BENCH --dry RED — do not commit" >&2
+    exit 1
+fi
+
+# compile-cache smoke: the persistent warm-start contract end to end. Two
+# processes share one FLAGS_compile_cache_dir: the cold run populates the
+# L2 store, the warm run must compile NOTHING (monitor misses == 0, every
+# executable deserialized) and reach its first fetched step >= 2x faster.
+# Then every entry's payload tail is bit-flipped in place — the store must
+# detect the checksum mismatch, fall back to a fresh compile (fallback
+# counter bumped, never an exception) and self-heal by re-putting. The
+# corruption targets the END of the file: the header JSON sits at the
+# front, and flipped bytes inside its hex strings parse fine by design
+# (the payload checksum is the integrity boundary, not the header text).
+cache_dir=$(mktemp -d /tmp/gate_aot_cache.XXXXXX)
+cold_out=$(JAX_PLATFORMS=cpu FLAGS_compile_cache_dir="$cache_dir" \
+    python bench.py --cache-child | tail -1)
+warm_out=$(JAX_PLATFORMS=cpu FLAGS_compile_cache_dir="$cache_dir" \
+    python bench.py --cache-child | tail -1)
+ls_out=$(python -m paddle_tpu cache ls --dir "$cache_dir" --json)
+python - "$cache_dir" <<'EOF'
+import glob, sys
+paths = glob.glob(sys.argv[1] + "/*.aot")
+assert paths, "no cache entries to corrupt"
+for p in paths:
+    with open(p, "r+b") as f:
+        f.seek(-16, 2)
+        tail = f.read(16)
+        f.seek(-16, 2)
+        f.write(bytes(b ^ 0xFF for b in tail))
+EOF
+fb_out=$(JAX_PLATFORMS=cpu FLAGS_compile_cache_dir="$cache_dir" \
+    python bench.py --cache-child | tail -1)
+COLD="$cold_out" WARM="$warm_out" LS="$ls_out" FB="$fb_out" python - <<'EOF'
+import json, os
+cold = json.loads(os.environ["COLD"])
+warm = json.loads(os.environ["WARM"])
+ls = json.loads(os.environ["LS"])
+fb = json.loads(os.environ["FB"])
+assert cold["compile_cache_misses"] >= 1, cold
+assert cold["cache_info"]["l2"]["puts"] >= 1, cold
+# warm-start contract: a fresh process against the populated dir compiles
+# NOTHING — L2 hits count as cache hits, so monitor misses are exactly 0
+assert warm["compile_cache_misses"] == 0, warm
+assert warm["cache_info"]["l2"]["hits"] >= 1, warm
+assert warm["first_loss"] == cold["first_loss"], (cold, warm)
+speedup = cold["start_to_first_step_ms"] / warm["start_to_first_step_ms"]
+assert speedup >= 2.0, (cold["start_to_first_step_ms"],
+                        warm["start_to_first_step_ms"])
+# the cache CLI must see exactly what the cold child put
+assert ls["entries"] and ls["total_bytes"] > 0, ls
+assert all(e["ok"] for e in ls["entries"]), ls
+# corrupted payloads: checksum mismatch -> fallback counter bumped, fresh
+# compile (misses reappear), identical loss, process exits clean
+assert fb["cache_info"]["l2"]["fallbacks"] >= 1, fb
+assert fb["compile_cache_misses"] >= 1, fb
+assert fb["first_loss"] == cold["first_loss"], (cold, fb)
+print(f"compile cache smoke: ok (warm start {speedup:.1f}x faster, "
+      f"{fb['cache_info']['l2']['fallbacks']} corrupt-entry fallbacks)")
+EOF
+rc=$?
+rm -rf "$cache_dir"
+if [ $rc -ne 0 ]; then
+    echo "GATE: COMPILE CACHE SMOKE RED — do not commit" >&2
     exit 1
 fi
 
