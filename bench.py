@@ -1032,6 +1032,105 @@ def _autoshard_ab(fluid):
     return out
 
 
+def _pipeline_ab(fluid):
+    """Pipeline-parallel A/B on the dp x pp mesh (parallel/pipeline): a
+    fixed-name 3-layer MLP trained 3 steps through the 1F1B
+    PipelineRunner at p=2/m=4, then replayed with n_stages=1 under
+    identical microbatching — bitwise loss parity, structural bubble vs
+    the analytic (p-1)/(m+p-1) bound, and the autoshard plan search
+    scored against the manual seed plan on the same model."""
+    import jax
+    from paddle_tpu.parallel import autoshard
+    from paddle_tpu.parallel.pipeline import PipelineRunner, analytic_bubble
+
+    n = len(jax.devices())
+    p_stages, m = 2, 4
+    mesh_axes = {"dp": max(1, n // 2), "pp": 2 if n >= 2 else 1}
+
+    def build():
+        main, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, start):
+            x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(x, 32, act="relu", name="ppb1")
+            h = fluid.layers.fc(h, 16, act="relu", name="ppb2")
+            pred = fluid.layers.fc(h, 1, name="ppb3")
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, start, loss.name
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(4 * m, 16).astype(np.float32)
+    ys = rs.randn(4 * m, 1).astype(np.float32)
+
+    losses, report = {}, None
+    for p in (1, p_stages):
+        main, start, loss_name = build()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            fluid.Executor(fluid.CPUPlace()).run(start)
+            runner = PipelineRunner(main, p, loss_name=loss_name,
+                                    feed_names=["x", "y"],
+                                    n_microbatches=m, scope=scope)
+            seq = []
+            for _ in range(3):
+                rep = runner.run({"x": xs, "y": ys})
+                seq.append(float(np.asarray(rep["loss"]).reshape(-1)[0]))
+            if p > 1:
+                report = rep
+        losses[p] = seq
+
+    # plan search on the same model: searched cost <= manual seed cost
+    # holds by construction; green_gate asserts it on this output
+    main, _, _ = build()
+    res = autoshard.search_plan(main, mesh_axes, batch_size=4 * m)
+    return {
+        "stages": p_stages,
+        "microbatches": m,
+        "bubble_fraction": report["bubble_fraction"],
+        "bubble_measured": report["bubble_measured"],
+        "bubble_analytic": analytic_bubble(p_stages, m),
+        "cut_bytes": report["plan"]["cut_bytes"],
+        "stage_balance": report["plan"]["balance"],
+        "loss_curves": {str(k): v for k, v in losses.items()},
+        "parity_bitwise": losses[1] == losses[p_stages],
+        "plan_cost_searched": res.cost["score_s"],
+        "plan_cost_manual": res.manual_cost["score_s"],
+        "plan_evaluated": res.evaluated,
+        "plan_improved": res.improved,
+        "mesh_axes": dict(mesh_axes),
+    }
+
+
+def measure_dry_pipeline_pp(fluid):
+    """bench.py --dry pipeline-parallel block (result key pipeline_pp —
+    "pipeline" is the fused input-pipeline block). The plan search
+    scores a dp x pp mesh, so with one local device re-exec onto an
+    8-device virtual CPU mesh and relay the child's JSON."""
+    import jax
+
+    if len(jax.devices()) >= 2:
+        return _pipeline_ab(fluid)
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    parts = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    parts.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(parts)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--pipeline-dry"],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"pipeline dry subprocess failed (rc={proc.returncode}): "
+            f"{proc.stderr[-500:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def measure_dry_autoshard(fluid):
     """bench.py --dry autoshard block. Propagation needs a real multi-axis
     mesh, so with one local device re-exec onto an 8-device virtual CPU
@@ -1387,6 +1486,13 @@ def measure_dry(fluid):
         result["overlap"] = measure_dry_overlap(fluid)
     except Exception as e:
         result["overlap_error"] = f"{type(e).__name__}: {e}"
+    # pipeline-parallel A/B (parallel/pipeline): 1F1B bubble vs the
+    # analytic bound, bitwise loss parity vs the unpartitioned replay,
+    # and the searched autoshard plan cost vs the manual seed plan
+    try:
+        result["pipeline_pp"] = measure_dry_pipeline_pp(fluid)
+    except Exception as e:
+        result["pipeline_pp_error"] = f"{type(e).__name__}: {e}"
     # persistent AOT cache: cold vs warm start-to-first-step across two
     # processes sharing one cache dir — the warm child must compile nothing
     try:
@@ -1518,6 +1624,11 @@ def main():
     if "--overlap-dry" in sys.argv:
         # child mode of measure_dry_overlap (8-device virtual CPU mesh)
         print(json.dumps(_overlap_ab(fluid)))
+        return
+
+    if "--pipeline-dry" in sys.argv:
+        # child mode of measure_dry_pipeline_pp (8-device virtual CPU mesh)
+        print(json.dumps(_pipeline_ab(fluid)))
         return
 
     if "--cache-child" in sys.argv:

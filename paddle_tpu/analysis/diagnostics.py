@@ -11,6 +11,8 @@ Code ranges:
   PTA020-PTA029  sharding/plan validation (mesh axes, divisibility, audit)
   PTA030-PTA039  dataflow-graph hazards (SSA def-use analysis; the checks
                  that make static reordering/overlap scheduling safe)
+  PTA040-PTA049  pipeline-partition legality (parallel.pipeline stage
+                 splits over the pp mesh axis)
 """
 
 __all__ = ["Severity", "Diagnostic", "Report", "ProgramVerificationError",
@@ -78,6 +80,14 @@ CATALOG = {
     "PTA034": (Severity.ERROR,
                "donation-aliasing race: stale view of a donated buffer "
                "read after the root's update"),
+    # -- pipeline-partition legality (parallel.pipeline) --------------------
+    "PTA040": (Severity.ERROR,
+               "pipeline partition crosses a dependency backwards: a "
+               "same-phase def-use edge runs from a later stage to an "
+               "earlier one, so no 1F1B order exists"),
+    "PTA041": (Severity.ERROR,
+               "pipeline boundary var rewritten after its send: the "
+               "receiving stage would observe a stale version"),
 }
 
 

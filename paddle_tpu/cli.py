@@ -295,6 +295,14 @@ def _cmd_checkpoint(args):
             for pname in sorted(params):
                 spec = ", ".join(str(a) for a in params[pname])
                 print(f"    {pname}: ({spec})")
+        pp = manifest.get("pipeline")
+        if pp:
+            print(f"  pipeline: stages={pp.get('stages')} "
+                  f"axis={pp.get('axis', 'pp')} "
+                  f"microbatches={pp.get('microbatches')} "
+                  f"schedule={pp.get('schedule', '1f1b')} "
+                  f"plan digest={pp.get('digest')} (params stored full; "
+                  f"restore requires a matching pp axis size)")
     elif report.get("format"):
         print(f"legacy io-format checkpoint (no manifest); files: "
               f"{len(report.get('files', []))}")
@@ -345,6 +353,8 @@ def _cmd_shard(args):
     if not mesh_axes:
         print("empty --mesh", file=sys.stderr)
         return 1
+    if args.shard_action == "search":
+        return _cmd_shard_search(args, mesh_axes)
     seeds = {}
     for s in args.seed or []:
         name, _, spec_s = s.partition("=")
@@ -378,6 +388,51 @@ def _cmd_shard(args):
         ok = ok and len(plan.sharded_names()) > 0
         # stderr so --json stdout stays machine-parseable
         print(f"shard plan selftest: {'OK' if ok else 'FAILED'}",
+              file=sys.stderr if args.json else sys.stdout)
+    return 0 if ok else 2
+
+
+def _cmd_shard_search(args, mesh_axes):
+    """`shard search`: enumerate seed placements, score whole plans with
+    the unified cost model, report the cheapest vs the manual seeds.
+    rc 0 search ok, 1 plan/search error, 2 selftest contract violated."""
+    import json
+
+    from .parallel import autoshard
+
+    if args.selftest:
+        program = _shard_demo_program()
+    elif args.model_dir:
+        loaded = _load_saved_program(args.model_dir)
+        if isinstance(loaded, str):
+            print(loaded, file=sys.stderr)
+            return 1
+        program = loaded[0]
+    else:
+        print("shard search needs --model-dir or --selftest",
+              file=sys.stderr)
+        return 1
+    try:
+        res = autoshard.search_plan(
+            program, mesh_axes, batch_axis=args.batch_axis,
+            batch_size=args.batch, hbm_budget=args.hbm_budget,
+            max_params=args.max_params, rounds=args.rounds)
+    except (TypeError, ValueError) as e:
+        print(f"shard search error: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(res.to_dict(), indent=2))
+    else:
+        print(res.render())
+        if not args.quiet:
+            print(res.plan.render(verbose=False))
+    ok = res.plan.is_total() and not res.plan.unresolved \
+        and res.cost["score_s"] <= res.manual_cost["score_s"]
+    if args.selftest:
+        # the searched plan must never lose to the manual seeds, and the
+        # demo net must actually end up sharded
+        ok = ok and len(res.plan.sharded_names()) > 0
+        print(f"shard search selftest: {'OK' if ok else 'FAILED'}",
               file=sys.stderr if args.json else sys.stdout)
     return 0 if ok else 2
 
@@ -551,6 +606,9 @@ def _cmd_analyze(args):
             program, _ = _z1.apply(program, args.zero1)
         return program, feeds
 
+    if args.analyze_action == "pipeline":
+        return _cmd_analyze_pipeline(args)
+
     if args.analyze_action == "graph":
         if args.selftest:
             prog, feeds, _ = _check_demo_program()
@@ -645,6 +703,155 @@ def _cmd_analyze(args):
     else:
         print(sched.render())
     return 0
+
+
+def _pipeline_demo_program():
+    """Fixed-name 3-layer MLP trainer for `analyze pipeline --selftest` —
+    explicit layer names so two builds yield identical param names (and
+    therefore identical startup init) for the parity comparison."""
+    import paddle_tpu as fluid
+
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 32, act="relu", name="pls1")
+        h = fluid.layers.fc(h, 16, act="relu", name="pls2")
+        p = fluid.layers.fc(h, 1, name="pls3")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, start, ["x", "y"], loss.name
+
+
+def _cmd_analyze_pipeline(args):
+    """`analyze pipeline`: partition a program over the pp axis, verify
+    the split (PTA040/041), and report the 1F1B schedule + bubble.
+
+    --selftest additionally 1F1B-executes the demo net at the requested
+    stage count, asserts bitwise loss parity against an unpartitioned
+    (n_stages=1) replay with identical microbatching, asserts the
+    structural bubble equals the analytic (p-1)/(m+p-1) bound, and
+    asserts a seeded backwards-edge mutation is REFUSED with PTA040.
+    rc 0 ok, 1 contract violated / illegal split, 2 usage error."""
+    import json
+
+    import numpy as np
+
+    from .analysis import ProgramVerificationError, Report
+    from .parallel import pipeline as pl
+
+    p, m = args.stages, args.microbatches
+    if p < 1 or m < 1:
+        print("--stages and --microbatches must be >= 1", file=sys.stderr)
+        return 2
+
+    if args.selftest:
+        from .core.scope import Scope
+        from .executor import Executor
+
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(4 * m, 16).astype(np.float32),
+                "y": rng.randn(4 * m, 1).astype(np.float32)}
+
+        def run(n_stages):
+            main, start, feeds, loss_name = _pipeline_demo_program()
+            scope = Scope()
+            Executor().run(start, scope=scope)
+            runner = pl.PipelineRunner(
+                main, n_stages, loss_name=loss_name, feed_names=feeds,
+                n_microbatches=m, scope=scope, batch_size=4 * m)
+            reports = [runner.run(feed) for _ in range(2)]
+            return [np.asarray(r["loss"]) for r in reports], reports[-1]
+
+        ref_losses, _ = run(1)
+        losses, rep = run(p)
+        parity = all((a == b).all() for a, b in zip(ref_losses, losses))
+        bound = pl.analytic_bubble(p, m)
+        bubble_ok = rep["bubble_fraction"] <= bound + 1e-9
+
+        # a split that sends forward data to an EARLIER stage must be
+        # refused, never silently executed (mirrors analyze schedule)
+        main, start, feeds, loss_name = _pipeline_demo_program()
+        plan = pl.partition(main, max(2, p), feed_names=feeds,
+                            batch_size=4 * m)
+        # force a forward def-use edge to run BACKWARDS: producer (the
+        # first matmul) onto the last stage, its consumer onto stage 0
+        ops = main.global_block().ops
+        u = min(i for i, op in enumerate(ops)
+                if plan.phases[i] == pl.PHASE_FWD and op.type == "mul")
+        outs = set(ops[u].output_arg_names())
+        v = min(i for i, op in enumerate(ops)
+                if i > u and plan.phases[i] == pl.PHASE_FWD
+                and outs & set(op.input_arg_names()))
+        plan.assignment[u] = plan.n_stages - 1
+        plan.assignment[v] = 0
+        rejected, codes = False, []
+        try:
+            pl.build_stage_programs(main, plan, feed_names=feeds,
+                                    fetch_names=[loss_name])
+        except ProgramVerificationError as e:
+            rejected = True
+            codes = sorted(e.report.codes())
+        ok = parity and bubble_ok and rejected and "PTA040" in codes
+        result = {
+            "ok": ok,
+            "parity_bitwise": parity,
+            "bubble_fraction": rep["bubble_fraction"],
+            "bubble_analytic": bound,
+            "bubble_measured": rep["bubble_measured"],
+            "n_stages": p, "n_microbatches": m,
+            "seeded_rejected": rejected, "seeded_codes": codes,
+            "plan": rep["plan"],
+        }
+        if args.json:
+            print(json.dumps(result, indent=2))
+        else:
+            print(f"pipeline: {p} stages x {m} microbatches  "
+                  f"bubble {rep['bubble_fraction']:.4f} "
+                  f"(analytic {bound:.4f}, measured "
+                  f"{rep['bubble_measured']:.4f})")
+            print(f"  bitwise loss parity vs n_stages=1: {parity}")
+            print(f"--- seeded backwards-edge clone: "
+                  f"{'rejected ' + str(codes) if rejected else 'NOT rejected'}"
+                  f" ---")
+            print(f"analyze pipeline selftest: {'OK' if ok else 'FAILED'}")
+        return 0 if ok else 1
+
+    if not args.model_dir:
+        print("analyze pipeline needs --model-dir or --selftest",
+              file=sys.stderr)
+        return 2
+    loaded = _load_saved_program(args.model_dir)
+    if isinstance(loaded, str):
+        print(loaded, file=sys.stderr)
+        return 2
+    program, feeds, _ = loaded
+    try:
+        plan = pl.partition(program, p, feed_names=feeds,
+                            batch_size=args.batch)
+    except ValueError as e:
+        print(f"analyze pipeline error: {e}", file=sys.stderr)
+        return 2
+    report = Report(level="full",
+                    context=f"analyze pipeline {args.model_dir}")
+    pl.check_partition(program, plan, report, feed_names=feeds)
+    sim = pl.simulate_schedule(pl.schedule_1f1b(p, m))
+    out = {
+        "plan": plan.to_dict(),
+        "bubble_analytic": pl.analytic_bubble(p, m),
+        "bubble_fraction": sim["bubble_fraction"],
+        "n_microbatches": m,
+        "report": report.to_dict(),
+    }
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        print(plan.describe())
+        print(f"  1F1B x {m} microbatches: bubble "
+              f"{sim['bubble_fraction']:.4f} "
+              f"(analytic {out['bubble_analytic']:.4f})")
+        print(report.render(verbose=not args.quiet))
+    return report.rc
 
 
 def _cmd_serve(args):
@@ -1103,6 +1310,36 @@ def main(argv=None):
                      help="emit plan.describe() as JSON")
     shp.add_argument("--quiet", action="store_true",
                      help="summary and edges only, no per-var table")
+    shse = shsub.add_parser(
+        "search", help="search candidate seed placements across the mesh "
+                       "axes and keep the whole-plan cheapest (unified "
+                       "compute + collective-bytes + peak-HBM cost model)")
+    shse.add_argument("--model-dir", default=None,
+                      help="save_inference_model directory to search")
+    shse.add_argument("--selftest", action="store_true",
+                      help="search the embedding+fc demo net and verify "
+                           "the searched plan never costs more than the "
+                           "manual seeds")
+    shse.add_argument("--mesh", default="dp=4,mp=2",
+                      help="mesh axes as name=size pairs")
+    shse.add_argument("--batch-axis", default="dp",
+                      help="mesh axis seeded onto data vars' dim 0")
+    shse.add_argument("--batch", type=int, default=8,
+                      help="batch size substituted for dynamic dims in "
+                           "the cost model")
+    shse.add_argument("--hbm-budget", type=int, default=None,
+                      metavar="BYTES",
+                      help="per-replica peak-HBM feasibility budget; "
+                           "plans over it are penalized out")
+    shse.add_argument("--max-params", type=int, default=8,
+                      help="search seed placements for the N largest "
+                           "params")
+    shse.add_argument("--rounds", type=int, default=2,
+                      help="greedy coordinate-descent passes")
+    shse.add_argument("--json", action="store_true",
+                      help="emit the search result as JSON")
+    shse.add_argument("--quiet", action="store_true",
+                      help="skip the winning plan's summary render")
 
     ck = sub.add_parser("check", help="static program verification: graph/"
                                       "safety/sharding checks and the "
@@ -1169,6 +1406,28 @@ def main(argv=None):
                       help="emit the schedule report as JSON")
     asch.add_argument("--quiet", action="store_true",
                       help="show errors only, not warnings")
+    apl = ansub.add_parser(
+        "pipeline", help="pp-axis stage partition (parallel.pipeline): "
+                         "min-cut plan, PTA040/041 legality, and the 1F1B "
+                         "schedule's bubble fraction")
+    apl.add_argument("--model-dir", default=None,
+                     help="save_inference_model directory to partition")
+    apl.add_argument("--stages", type=int, default=2,
+                     help="pipeline stage count (pp axis size)")
+    apl.add_argument("--microbatches", type=int, default=4,
+                     help="1F1B microbatches per step")
+    apl.add_argument("--batch", type=int, default=1,
+                     help="batch size substituted for dynamic dims in the "
+                          "FLOPs/bytes models")
+    apl.add_argument("--selftest", action="store_true",
+                     help="1F1B-execute the demo net (bitwise loss parity "
+                          "vs unpartitioned, bubble <= analytic bound) AND "
+                          "verify a seeded backwards-edge split is refused "
+                          "with PTA040; rc 0 when all hold")
+    apl.add_argument("--json", action="store_true",
+                     help="emit the report as JSON")
+    apl.add_argument("--quiet", action="store_true",
+                     help="show errors only, not warnings")
 
     s = sub.add_parser("serve", help="serve a saved inference model with "
                                      "the batching engine")

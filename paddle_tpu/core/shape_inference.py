@@ -1195,7 +1195,8 @@ def _crf_dynamic(ctx):
 
 
 # -- collectives -----------------------------------------------------------
-@register_infer_shape("all_reduce", "broadcast", "collective_permute")
+@register_infer_shape("all_reduce", "broadcast", "collective_permute",
+                      "pipeline_send", "pipeline_recv")
 def _coll_same(ctx):
     x = ctx.input_dim("X")
     if x is not None:

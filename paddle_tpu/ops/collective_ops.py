@@ -47,6 +47,14 @@ COLLECTIVE_RW = {
                            "aliases": {"Out": "X"}, "pending": "axis_name"},
     "zero1_gather":       {"reads": ("X",), "writes": ("Out",),
                            "aliases": {"Out": "X"}, "pending": "axis_name"},
+    # pipeline-parallel stage boundaries (parallel.pipeline): a send marks
+    # a value leaving its producing stage toward `peer` on the pp axis, a
+    # recv marks it arriving. Off-mesh (the serial-replay / host-staged
+    # runner path) both are identities.
+    "pipeline_send":      {"reads": ("X",), "writes": ("Out",),
+                           "aliases": {}, "pending": "axis_name"},
+    "pipeline_recv":      {"reads": ("X",), "writes": ("Out",),
+                           "aliases": {}, "pending": "axis_name"},
 }
 
 
@@ -185,3 +193,28 @@ def collective_permute_op(ctx, ins, attrs):
     if not _in_mapped_axis(axis):
         return out(Out=x)
     return out(Out=jax.lax.ppermute(x, axis, perm))
+
+
+def _pp_shift(x, attrs):
+    """Shared lowering for the pipeline boundary pair: a ppermute shifting
+    by `peer` hops along the pp axis when it is mapped, identity otherwise
+    (the host-staged runner moves the value between stage programs itself,
+    so the ops are markers for the analyses and the SPMD lowering)."""
+    axis = attrs.get("axis_name", "pp")
+    if not _in_mapped_axis(axis):
+        return x
+    n = jax.lax.axis_size(axis)
+    hop = int(attrs.get("peer", 1))
+    perm = [(i, (i + hop) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+@register_op("pipeline_send")
+def pipeline_send_op(ctx, ins, attrs):
+    return out(Out=_pp_shift(first(ins, "X"), attrs))
+
+
+@register_op("pipeline_recv")
+def pipeline_recv_op(ctx, ins, attrs):
+    # the shift happened on the send side; recv materializes the arrival
+    return out(Out=first(ins, "X"))

@@ -10,11 +10,16 @@ Reference mapping (SURVEY.md §2.4):
                            (FLAGS_zero1 / BuildStrategy.sharded_weight_update)
   (absent in reference) -> autoshard/ GSPMD-style sharding propagation
                            (FLAGS_autoshard / BuildStrategy.auto_sharding)
+                           + search.py whole-plan seed search
+  (absent in reference) -> pipeline/ inter-op pipeline parallelism over
+                           the pp mesh axis (1F1B; NOT the input-feeder
+                           shim in paddle_tpu/pipeline.py)
 """
 
 from . import mesh
 from . import zero1
 from . import autoshard
+from . import pipeline
 from . import distributed
 from . import rpc
 from . import ring
@@ -37,7 +42,7 @@ from .flash import flash_attention
 
 __all__ = [
     "mesh", "distributed", "rpc", "ring", "sharded_embedding", "api",
-    "flash", "zero1", "autoshard", "elastic",
+    "flash", "zero1", "autoshard", "pipeline", "elastic",
     "make_mesh", "data_parallel_mesh", "mesh_scope",
     "mesh_geometry", "MeshSpec",
     "ElasticController", "ElasticConfig", "ElasticError", "Resized",

@@ -21,6 +21,8 @@ from .plan import ShardingPlan, transition_bytes
 from .rules import register_rule, rule_for, registered_ops
 from .propagate import (build_plan, validate_seeds, register_plan,
                         active_plan, reset_registry, manifest_section)
+from .search import (plan_cost, enumerate_seed_candidates, search_plan,
+                     SearchResult)
 
 __all__ = [
     "normalize_spec", "canon", "pad_spec", "spec_str",
@@ -28,4 +30,6 @@ __all__ = [
     "register_rule", "rule_for", "registered_ops",
     "build_plan", "validate_seeds",
     "register_plan", "active_plan", "reset_registry", "manifest_section",
+    "plan_cost", "enumerate_seed_candidates", "search_plan",
+    "SearchResult",
 ]

@@ -1,4 +1,9 @@
-"""GSPMD-style sharding propagation over a Program's global block.
+"""GSPMD-style sharding propagation over a Program.
+
+The fixpoint sweeps the global block with while/cond sub-block ops
+folded inline (their def-use summarized onto the parent walk, like
+analysis.dataflow does), so specs flow into loop bodies and back out
+through escaping writes.
 
 Seeds (user `parallel.set_sharding` annotations, plus the batch axis on
 data vars) are pushed through the op graph by the per-op rules in
@@ -69,19 +74,44 @@ def validate_seeds(program, mesh_axes):
         validate_seed_spec(name, s, v.shape, mesh_axes)
 
 
-def build_plan(program, mesh_axes, batch_axis="dp", extra_seeds=None):
+def build_plan(program, mesh_axes, batch_axis="dp", extra_seeds=None,
+               ignore_program_seeds=False):
     """Produce a total ShardingPlan for `program` on a {axis: size} mesh.
 
     `extra_seeds` ({name: spec}) adds seeds without mutating the program
-    (used by the CLI). Raises ValueError on invalid seeds."""
+    (used by the CLI; program annotations still win on collision).
+    `ignore_program_seeds` drops the program's own `set_sharding`
+    annotations so `extra_seeds` fully define the seeding — the search in
+    search.py uses this to evaluate candidate placements side by side.
+    Raises ValueError on invalid seeds."""
     mesh_axes = {str(k): int(v) for k, v in dict(mesh_axes).items()}
     block = program.global_block()
     plan = ShardingPlan(mesh_axes, batch_axis=batch_axis)
 
-    for name, v in block.vars.items():
-        plan.shapes[name] = None if v.shape is None else tuple(v.shape)
-        plan.dtypes[name] = str(getattr(v, "dtype", "float32"))
-        plan.specs[name] = None
+    # Register vars and flatten ops across while/cond sub-blocks: the
+    # sweep visits sub-block ops inline, right after the op that owns
+    # them (the way dataflow._summarize_sub folds their def-use onto the
+    # parent node), so a loop body reading a sharded param propagates
+    # specs through body-locals and back out via escaping writes. Names
+    # the sub-block does NOT redeclare resolve to the parent var, which
+    # is already registered — parent entries win on collision.
+    def _register_vars(blk):
+        for name, v in blk.vars.items():
+            if name in plan.specs:
+                continue
+            plan.shapes[name] = None if v.shape is None else tuple(v.shape)
+            plan.dtypes[name] = str(getattr(v, "dtype", "float32"))
+            plan.specs[name] = None
+
+    def _flatten_ops(blk, into):
+        for op in blk.ops:
+            into.append(op)
+            for a in op.attrs.values():
+                if hasattr(a, "ops") and hasattr(a, "vars"):
+                    _register_vars(a)
+                    _flatten_ops(a, into)
+
+    _register_vars(block)
 
     state = {}  # name -> (canonical spec, source)
 
@@ -136,10 +166,11 @@ def build_plan(program, mesh_axes, batch_axis="dp", extra_seeds=None):
 
     # -- seeds ------------------------------------------------------------
     seeds = {}
-    for name, v in block.vars.items():
-        s = getattr(v, "sharding", None)
-        if s is not None:
-            seeds[name] = s
+    if not ignore_program_seeds:
+        for name, v in block.vars.items():
+            s = getattr(v, "sharding", None)
+            if s is not None:
+                seeds[name] = s
     for name, s in dict(extra_seeds or {}).items():
         seeds.setdefault(name, s)
     for name, s in seeds.items():
@@ -154,7 +185,8 @@ def build_plan(program, mesh_axes, batch_axis="dp", extra_seeds=None):
                 assign(name, (batch_axis,), SRC_FEED)
 
     # -- fixpoint ---------------------------------------------------------
-    ops = list(block.ops)
+    ops = []
+    _flatten_ops(block, ops)
     ctx = _Ctx(state, plan.shapes, mesh_axes)
     grad_names = [n for n in plan.specs if GRAD_VAR_SUFFIX in n]
 

@@ -522,6 +522,10 @@ class ParallelExecutor:
              osched.plan.digest() if osched is not None else None),
             ("autoshard", aplan.digest() if aplan is not None else None),
             ("health", hplan.digest if hplan is not None else None),
+            # stage programs from parallel.pipeline share var names with
+            # each other and the source program; the (plan digest, stage,
+            # phase) tag keeps their executables from colliding
+            ("pipeline", getattr(program, "_pipeline_stage", None)),
         )
         entry = self._compile_cache.get(cache_key)
         fp = monitor.fingerprint_of(cache_key) if mon is not None else None

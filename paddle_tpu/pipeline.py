@@ -6,6 +6,11 @@ buffers, per-stage stats and backpressure); this module keeps the original
 class as a thin wrapper over datapipe.AsyncDeviceFeeder so existing call
 sites keep working. New code should build a datapipe.DataPipe
 (.batch().prefetch_to_device(chunk=K)) or use AsyncDeviceFeeder directly.
+
+NAME COLLISION NOTE: this module is the *input*-pipeline shim and is
+unrelated to ``paddle_tpu.parallel.pipeline``, the pipeline-*parallelism*
+package (ProgramDesc partitioning over a ``pp`` mesh axis with 1F1B
+microbatch scheduling — see docs/pipeline.md).
 """
 
 import warnings

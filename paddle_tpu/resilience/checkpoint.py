@@ -288,6 +288,16 @@ class CheckpointManager:
         ainfo = _autoshard.manifest_section(snap)
         if ainfo:
             manifest["autoshard"] = ainfo
+        # Pipeline parallelism (parallel.pipeline): stage count, pp axis,
+        # microbatches, schedule. Purely descriptive — the snapshot holds
+        # every stage's params in full layout — but `checkpoint inspect`
+        # renders it, and the pp axis also rides the mesh section below,
+        # where check_mesh_compat refuses a pp-mismatched restore.
+        from ..parallel import pipeline as _pipeline
+
+        pinfo = _pipeline.manifest_section()
+        if pinfo:
+            manifest["pipeline"] = pinfo
         # Mesh geometry: which {axis: size} shape produced this state.
         # Restores compare it against the target mesh and refuse a non-dp
         # conflict (check_mesh_compat) instead of silently corrupting.

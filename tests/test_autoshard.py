@@ -337,3 +337,118 @@ def test_checkpoint_manifest_records_autoshard_plan(tmp_path):
     assert list(info["params"]["emb_w"]) == ["mp"]  # canonical trimmed form
     # the checkpoint stores the canonical FULL layout for sharded params
     assert tuple(man["vars"]["emb_w"]["shape"]) == (32, 16)
+
+
+# ---------------------------------------------------------------------------
+# propagation through while/cond sub-blocks (satellite of the pp PR)
+# ---------------------------------------------------------------------------
+def _while_net():
+    """A while loop whose body reads a sharded param: the body's local
+    temporaries must pick up derived layouts from the parent seed."""
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype="int64",
+                                           value=3)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            h = fluid.layers.fc(input=x, size=16,
+                                param_attr=fluid.ParamAttr(name="w_loop"))
+            fluid.layers.assign(h, x)
+            fluid.layers.increment(x=i, value=1, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+        set_sharding(main.global_block().var("w_loop"), (None, "mp"))
+    sub = next(v for op in main.global_block().ops
+               for v in op.attrs.values()
+               if hasattr(v, "ops") and hasattr(v, "vars"))
+    return main, sub
+
+
+def test_while_body_reading_sharded_param_derives_layouts():
+    main, sub = _while_net()
+    plan = autoshard.build_plan(main, MESH)
+    assert plan.spec_of("w_loop") == (None, "mp")
+    # the body's matmul output: batch rows from x, cols from the
+    # col-sharded weight — exactly what straight-line code derives
+    mul_out = next(op.output_arg_names()[0] for op in sub.ops
+                   if op.type == "mul")
+    assert plan.spec_of(mul_out) == ("dp", "mp")
+    # every body-local temporary participates in the (total) plan
+    for op in sub.ops:
+        for n in op.output_arg_names():
+            assert n in plan.specs, n
+    assert plan.is_total()
+
+
+def test_while_body_vars_shadowed_by_parent_keep_parent_spec():
+    main, sub = _while_net()
+    plan = autoshard.build_plan(main, MESH)
+    # `x` lives in the PARENT block (the body reads and assigns it); the
+    # parent's feed seed ("dp",) outranks the body-derived layout — the
+    # sub-block fold must not let a body op overwrite a parent binding
+    assert plan.spec_of("x") == ("dp",)
+    # the loop counter stays replicated: nothing shards a scalar
+    assert plan.spec_of("i") in ((), None) or plan.spec_of("i") == ()
+
+
+# ---------------------------------------------------------------------------
+# plan search (autoshard/search.py)
+# ---------------------------------------------------------------------------
+def _search_net():
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(
+            ids, size=[64, 32], param_attr=fluid.ParamAttr(name="emb_w"))
+        h = fluid.layers.fc(input=emb, size=64,
+                            param_attr=fluid.ParamAttr(name="w1"))
+        p = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main
+
+
+def test_enumerate_candidates_skips_batch_axis_and_invalid_dims():
+    main = _search_net()
+    cands = autoshard.enumerate_seed_candidates(main, MESH, min_bytes=1)
+    assert "emb_w" in cands and "w1" in cands
+    for specs in cands.values():
+        assert () in specs           # replicated is always a candidate
+        for s in specs:
+            assert "dp" not in s     # the batch axis is the data axis
+    # every candidate passes seed validation (divisibility, rank)
+    assert (None, "mp") in cands["emb_w"] and ("mp",) in cands["emb_w"]
+
+
+def test_search_plan_never_costs_more_than_manual_seeds():
+    main = _search_net()
+    set_sharding(main.global_block().var("emb_w"), ("mp", None))
+    res = autoshard.search_plan(main, MESH, batch_size=16)
+    assert res.evaluated > 1
+    assert res.cost["score_s"] <= res.manual_cost["score_s"]
+    assert res.plan.is_total() and not res.plan.unresolved
+    d = res.to_dict()
+    assert d["digest"] == res.plan.digest()
+    assert "searched score" in res.render()
+
+
+def test_plan_cost_models_sharded_compute_and_hbm_feasibility():
+    main = _search_net()
+    mesh = dict(MESH)
+    replicated = autoshard.build_plan(main, mesh, ignore_program_seeds=True)
+    sharded = autoshard.build_plan(
+        main, mesh, extra_seeds={"w1": (None, "mp")},
+        ignore_program_seeds=True)
+    c_rep = autoshard.plan_cost(main, replicated, batch_size=16)
+    c_sh = autoshard.plan_cost(main, sharded, batch_size=16)
+    # sharding w1 divides its matmul FLOPs across mp
+    assert c_sh["compute_s"] < c_rep["compute_s"]
+    assert c_rep["feasible"] and c_sh["feasible"]
+    # an absurdly small budget flips feasibility into a dominating penalty
+    tight = autoshard.plan_cost(main, sharded, batch_size=16, hbm_budget=1)
+    assert not tight["feasible"]
+    assert tight["score_s"] > c_sh["score_s"] * 1e6

@@ -1,0 +1,310 @@
+"""Pipeline parallelism (parallel/pipeline) — NOT the input-pipeline shim
+paddle_tpu/pipeline.py, which tests/test_pipeline.py covers.
+
+Contracts pinned here:
+
+* partition: every real op assigned a (stage, phase) cell, backward ops
+  co-located with their forward twin, FLOPs balance within slack, digest
+  stable under re-partition;
+* legality: a seeded backwards stage edge is flagged PTA040 and the
+  rewriter REFUSES it; a twice-written boundary var is flagged PTA041;
+* 1F1B schedule: warmup/alternation shape, unit-cost simulated bubble
+  exactly (p-1)/(m+p-1);
+* the property test: any hazard-free stage split replayed serially
+  through PipelineRunner is BITWISE identical to the unpartitioned
+  (n_stages=1) program over 3 training steps;
+* checkpoint: the manifest stamps pp geometry next to mesh/autoshard and
+  a pp-mismatched restore raises a clear ValueError.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.analysis.diagnostics import (ProgramVerificationError,
+                                             Report)
+from paddle_tpu.core.framework import Program, program_guard
+from paddle_tpu.parallel import pipeline
+from paddle_tpu.parallel.pipeline import (PHASE_BWD, PHASE_FWD, PHASE_OPT,
+                                          PipelineRunner, StagePlan,
+                                          analytic_bubble,
+                                          build_stage_programs,
+                                          check_partition, partition,
+                                          schedule_1f1b, simulate_schedule)
+
+FEEDS = ["x", "y"]
+
+
+def _trainer():
+    """Fixed layer names: two builds give identical param names + init."""
+    main, start = Program(), Program()
+    with program_guard(main, start):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 32, act="relu", name="tpp1")
+        h = fluid.layers.fc(h, 16, act="relu", name="tpp2")
+        p = fluid.layers.fc(h, 1, name="tpp3")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, start, loss.name
+
+
+# ---------------------------------------------------------------------------
+# partitioner
+# ---------------------------------------------------------------------------
+def test_partition_assigns_every_op_and_colocates_backward():
+    main, _, _ = _trainer()
+    plan = partition(main, 3, feed_names=FEEDS)
+    ops = main.global_block().ops
+    for i, op in enumerate(ops):
+        if op.type in ("feed", "fetch"):
+            continue
+        assert plan.stage_of(i) is not None, (i, op.type)
+        assert plan.phases[i] in (PHASE_FWD, PHASE_BWD, PHASE_OPT)
+    # stages are a contiguous forward split: fwd stage ids never decrease
+    seen = [plan.stage_of(i) for i, op in enumerate(ops)
+            if plan.phases[i] == PHASE_FWD and plan.stage_of(i) is not None]
+    assert seen == sorted(seen)
+    assert set(plan.assignment.values()) == set(range(3))
+    assert plan.balance() >= 1.0
+    # digest is deterministic and feeds caches/manifests
+    assert plan.digest() == partition(main, 3, feed_names=FEEDS).digest()
+    d = plan.to_dict()
+    assert d["n_stages"] == 3 and d["axis"] == "pp"
+    assert len(d["stage_flops"]) == 3
+    assert "stage" in plan.describe()
+
+
+def test_partition_cut_tracks_boundary_bytes():
+    main, _, _ = _trainer()
+    plan = partition(main, 2, feed_names=FEEDS)
+    assert plan.boundaries, "a 2-stage MLP split must ship activations"
+    total = sum(b["bytes"] for b in plan.boundaries)
+    assert plan.cut_bytes == pytest.approx(total)
+    for b in plan.boundaries:
+        assert b["dst"] > b["src"]
+
+
+def test_clean_partition_passes_check():
+    main, _, _ = _trainer()
+    plan = partition(main, 2, feed_names=FEEDS)
+    rep = check_partition(main, plan, Report(level="full", context="t"),
+                          feed_names=FEEDS)
+    assert not rep.errors(), [d.code for d in rep.diagnostics]
+
+
+# ---------------------------------------------------------------------------
+# legality: the rewriter refuses seeded-hazard splits (PTA040 / PTA041)
+# ---------------------------------------------------------------------------
+def _force_backwards_edge(main, plan):
+    """Move a fwd producer to the last stage and its direct same-phase
+    consumer to stage 0 — no 1F1B order can satisfy that edge."""
+    ops = main.global_block().ops
+    u = next(i for i, op in enumerate(ops)
+             if plan.phases[i] == PHASE_FWD and op.type == "mul")
+    out = ops[u].output_arg_names()[0]
+    v = next(i for i in range(u + 1, len(ops))
+             if plan.phases[i] == PHASE_FWD
+             and out in ops[i].input_arg_names())
+    plan.assignment[u] = plan.n_stages - 1
+    plan.assignment[v] = 0
+    return plan
+
+
+def test_seeded_backwards_edge_flagged_pta040_and_refused():
+    main, _, loss_name = _trainer()
+    plan = _force_backwards_edge(
+        main, partition(main, 2, feed_names=FEEDS))
+    rep = check_partition(main, plan, Report(level="full", context="t"),
+                          feed_names=FEEDS)
+    assert "PTA040" in rep.codes()
+    with pytest.raises(ProgramVerificationError) as ei:
+        build_stage_programs(main, plan, feed_names=FEEDS,
+                             fetch_names=[loss_name])
+    assert "PTA040" in str(ei.value)
+
+
+def test_rewritten_boundary_var_flagged_pta041():
+    # increment(in_place=True) writes its operand a second time; a plan
+    # that ships that var across a stage boundary would deliver a stale
+    # version to the consumer — check_partition must say so
+    main, start = Program(), Program()
+    with program_guard(main, start):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        s = fluid.layers.reduce_sum(x)
+        t = fluid.layers.scale(s, scale=2.0)
+        fluid.layers.increment(x=s, value=1.0, in_place=True)
+        fluid.layers.scale(t, scale=1.0)
+    ops = main.global_block().ops
+    n = len(ops)
+    plan = StagePlan(
+        2, {i: (0 if i < 2 else 1) for i in range(n)},
+        [PHASE_FWD] * n, [1.0, 1.0],
+        [{"var": s.name, "src": 0, "dst": 1, "bytes": 4.0}], 4.0)
+    rep = check_partition(main, plan, Report(level="full", context="t"),
+                          feed_names=["x"])
+    assert "PTA041" in rep.codes()
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("p,m", [(1, 4), (2, 4), (3, 6), (4, 8), (2, 1)])
+def test_1f1b_unit_cost_bubble_equals_analytic(p, m):
+    events = schedule_1f1b(p, m)
+    assert len(events) == p
+    for s, ev in enumerate(events):
+        fs = [mb for k, mb in ev if k == "F"]
+        bs = [mb for k, mb in ev if k == "B"]
+        assert fs == list(range(m)) and bs == list(range(m))
+        # warmup depth shrinks toward the last stage
+        warm = 0
+        for k, _ in ev:
+            if k != "F":
+                break
+            warm += 1
+        assert warm == min(m, p - s)
+    sim = simulate_schedule(events)
+    assert sim["bubble_fraction"] == pytest.approx(analytic_bubble(p, m))
+
+
+def test_analytic_bubble_formula():
+    assert analytic_bubble(1, 4) == 0.0
+    assert analytic_bubble(2, 4) == pytest.approx(1 / 5)
+    assert analytic_bubble(4, 8) == pytest.approx(3 / 11)
+
+
+# ---------------------------------------------------------------------------
+# stage rewriter interfaces
+# ---------------------------------------------------------------------------
+def test_stage_programs_wire_send_recv_pairs():
+    main, _, loss_name = _trainer()
+    plan = partition(main, 2, feed_names=FEEDS)
+    stages = build_stage_programs(main, plan, feed_names=FEEDS,
+                                  fetch_names=[loss_name])
+    assert (0, PHASE_FWD) in stages and (1, PHASE_BWD) in stages
+    sends = {n for sp in stages.values() for n in sp.boundary_out}
+    recvs = {n for sp in stages.values() for n in sp.boundary_in}
+    assert sends and sends == recvs
+    for sp in stages.values():
+        optypes = [op.type for op in sp.program.global_block().ops]
+        assert optypes.count("pipeline_recv") == len(sp.boundary_in)
+        assert optypes.count("pipeline_send") == len(sp.boundary_out)
+        for n in sp.boundary_out:
+            assert n + "@PPOUT" in sp.fetch_names
+        # the cache key must distinguish stage programs sharing var names
+        assert sp.program._pipeline_stage == (plan.digest(), sp.stage,
+                                              sp.phase)
+    # the loss is owned by exactly one cell
+    owners = [sp for sp in stages.values() if loss_name in sp.user_fetches]
+    assert len(owners) == 1 and owners[0].stage == 1
+
+
+# ---------------------------------------------------------------------------
+# the property test: serial replay of any legal split is bitwise-exact
+# ---------------------------------------------------------------------------
+def _run_steps(n_stages, m, steps=3):
+    main, start, loss_name = _trainer()
+    scope = fluid.Scope()
+    rs = np.random.RandomState(0)
+    xs = rs.randn(4 * m, 16).astype(np.float32)
+    ys = rs.randn(4 * m, 1).astype(np.float32)
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(start)
+        runner = PipelineRunner(main, n_stages, loss_name=loss_name,
+                                feed_names=FEEDS, n_microbatches=m,
+                                scope=scope)
+        out = []
+        for _ in range(steps):
+            rep = runner.run({"x": xs, "y": ys})
+            out.append(np.asarray(rep["loss"]).reshape(-1)[0])
+    return out, rep
+
+
+@pytest.mark.parametrize("p", [2, 3, 4])
+def test_pipeline_replay_bitwise_matches_unpartitioned(p):
+    m = 4
+    ref, _ = _run_steps(1, m)
+    got, rep = _run_steps(p, m)
+    assert [g.tobytes() for g in got] == [r.tobytes() for r in ref]
+    assert ref[-1] < ref[0], "the property must hold on a LEARNING run"
+    # structural bubble of the executed order == the analytic bound
+    assert rep["bubble_fraction"] == pytest.approx(analytic_bubble(p, m))
+    assert rep["n_stages"] == p and rep["n_microbatches"] == m
+
+
+def test_runner_validates_microbatching():
+    main, start, loss_name = _trainer()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(start)
+        runner = PipelineRunner(main, 2, loss_name=loss_name,
+                                feed_names=FEEDS, n_microbatches=3,
+                                scope=scope)
+        with pytest.raises(ValueError, match="not splittable"):
+            runner.run({"x": np.zeros((8, 16), np.float32),
+                        "y": np.zeros((8, 1), np.float32)})
+    with pytest.raises(ValueError):
+        PipelineRunner(main, 0, loss_name=loss_name, feed_names=FEEDS)
+
+
+def test_runner_exports_bubble_gauges():
+    from paddle_tpu import monitor
+
+    m = 4
+    _run_steps(2, m)
+    snap = monitor.registry().snapshot()
+    assert snap.get("pipeline_stages") == 2.0
+    assert snap.get("pipeline_microbatches") == float(m)
+    assert snap.get("pipeline_bubble_fraction") == pytest.approx(
+        analytic_bubble(2, m))
+    assert snap.get("pipeline_bubble_analytic") == pytest.approx(
+        analytic_bubble(2, m))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manifest: pp geometry rides next to mesh/zero1/autoshard
+# ---------------------------------------------------------------------------
+def test_checkpoint_manifest_stamps_pp_geometry(tmp_path, capsys):
+    from paddle_tpu.cli import main as cli_main
+    from paddle_tpu.resilience.checkpoint import (CheckpointManager,
+                                                  inspect_dir)
+
+    m = 2
+    main, start, loss_name = _trainer()
+    scope = fluid.Scope()
+    rs = np.random.RandomState(0)
+    feed = {"x": rs.randn(2 * m, 16).astype(np.float32),
+            "y": rs.randn(2 * m, 1).astype(np.float32)}
+    pipeline.reset_registry()
+    try:
+        with fluid.scope_guard(scope):
+            fluid.Executor(fluid.CPUPlace()).run(start)
+            runner = PipelineRunner(main, 2, loss_name=loss_name,
+                                    feed_names=FEEDS, n_microbatches=m,
+                                    scope=scope)
+            runner.run(feed)
+            cm = CheckpointManager(str(tmp_path), async_write=False)
+            cm.mesh_axes = {"dp": 4, "pp": 2}
+            cm.save(3, scope=scope, program=main)
+        rep = inspect_dir(str(tmp_path))
+        info = rep["manifest"]["pipeline"]
+        assert info["stages"] == 2 and info["microbatches"] == m
+        assert info["axis"] == "pp" and info["schedule"] == "1f1b"
+        assert info["digest"] == runner.plan.digest()
+        assert rep["manifest"]["mesh"] == {"dp": 4, "pp": 2}
+
+        # `checkpoint inspect` renders the section
+        rc = cli_main(["checkpoint", "inspect", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "pipeline: stages=2" in out and "schedule=1f1b" in out
+
+        # dp resize is fine; a pp mismatch must refuse BEFORE any var load
+        cm.restore(scope=fluid.Scope(), program=main,
+                   expect_mesh={"dp": 2, "pp": 2})
+        with pytest.raises(ValueError, match="mesh geometry conflict.*pp"):
+            cm.restore(scope=fluid.Scope(), program=main,
+                       expect_mesh={"dp": 4, "pp": 4})
+    finally:
+        pipeline.reset_registry()

@@ -298,6 +298,15 @@ assert o is not None, result.get("overlap_error", result)
 assert o["loss_parity_max_abs_diff"] == 0.0, o
 assert o["plan"]["moves"] >= 1 and o["plan"]["buckets"] >= 1, o
 assert o["on_delta_ok"], o
+# pipeline-parallel A/B (parallel/pipeline): 1F1B replay must be BITWISE
+# loss-identical to the unpartitioned reference, the structural bubble
+# must respect the analytic (p-1)/(m+p-1) bound, and the searched
+# autoshard plan must cost no more than the manual seed plan
+pp = result.get("pipeline_pp")
+assert pp is not None, result.get("pipeline_pp_error", result)
+assert pp["parity_bitwise"], pp
+assert pp["bubble_fraction"] <= pp["bubble_analytic"] + 1e-9, pp
+assert pp["plan_cost_searched"] <= pp["plan_cost_manual"], pp
 # health overhead A/B: FLAGS_health=0 must stay one flag check (the same
 # <=1%/0.25ms gate as trace), and the warm enabled-at-interval-10 loop —
 # fused stat reductions in the step, readback skipped 9 of 10 steps —
@@ -606,6 +615,25 @@ fi
 JAX_PLATFORMS=cpu python -m paddle_tpu analyze schedule --selftest --quiet
 if [ $? -ne 0 ]; then
     echo "GATE: ANALYZE SCHEDULE SELFTEST RED — do not commit" >&2
+    exit 1
+fi
+
+# shard search CLI: the seed-placement search must evaluate >1 candidate
+# plan on the demo net and come back with a total plan whose cost is <=
+# the manual seed plan's (the search's core contract)
+JAX_PLATFORMS=cpu python -m paddle_tpu shard search --selftest --quiet
+if [ $? -ne 0 ]; then
+    echo "GATE: SHARD SEARCH CLI RED — do not commit" >&2
+    exit 1
+fi
+
+# analyze pipeline CLI selftest: 1F1B-executes the demo net at p=2/m=4,
+# asserts bitwise loss parity vs the unpartitioned replay, structural
+# bubble <= the analytic (p-1)/(m+p-1) bound, and that a seeded
+# backwards-edge mutation is REFUSED with PTA040
+JAX_PLATFORMS=cpu python -m paddle_tpu analyze pipeline --selftest --quiet
+if [ $? -ne 0 ]; then
+    echo "GATE: ANALYZE PIPELINE SELFTEST RED — do not commit" >&2
     exit 1
 fi
 
