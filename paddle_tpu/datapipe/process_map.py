@@ -59,6 +59,21 @@ define("datapipe_start_method", str, "",
 define("datapipe_restart_workers", bool, False,
        "Restart a died datapipe decode worker (re-dispatching its "
        "in-flight items) instead of raising DataPipeError.")
+define("datapipe_pin_workers", bool, False,
+       "Pin each datapipe decode worker process to one CPU core "
+       "(round-robin over the parent's affinity mask, parent's own core "
+       "last) so decode never migrates across cores mid-chunk. No-op on "
+       "single-core hosts and platforms without sched_setaffinity.")
+define("datapipe_readahead", int, 0,
+       "In-flight decode items for the fused process map (0 = auto: "
+       "deep enough to keep every ring slot's chunk assembling, "
+       "ring_slots * chunk, floored at 2 * num_workers). Plain-mode "
+       "maps keep buffer_size semantics.")
+define("datapipe_dispatch_batch", int, 0,
+       "Items per dispatch message on the fused shm path (0 = auto: "
+       "chunk // num_workers, min 1). Batching cuts the per-item "
+       "queue/pipe round-trips that bound single-core decode rate; 1 "
+       "restores item-granular dispatch.")
 
 
 class DataPipeError(RuntimeError):
@@ -90,9 +105,11 @@ def _worker_main(wid, fn, task_q, conn):
     """Worker process body: decode tasks until the stop pill.
 
     Messages in (task_q): ("task", idx, slot, off, item) /
-    ("probe", idx, item) / ("ring", meta, wire) / ("stop",).
+    ("taskb", idx0, slot, off0, [items]) — a coalesced run of shm rows —
+    / ("probe", idx, item) / ("ring", meta, wire) / ("stop",).
     Messages out (conn): ("ok", idx, res, dur) / ("okshm", idx, dur) /
-    ("probe_ok", idx, res, dur) / ("err", idx, etype, msg, tb).
+    ("okshmb", idx0, n, dur) / ("probe_ok", idx, res, dur) /
+    ("err", idx, etype, msg, tb).
     """
     import traceback
 
@@ -116,6 +133,15 @@ def _worker_main(wid, fn, task_q, conn):
                     res = fn(item)
                     dur = time.perf_counter() - t0
                     conn.send(("probe_ok", idx, res, dur))
+                elif kind == "taskb":
+                    # coalesced dispatch: decode a run of rows into one
+                    # slot, one ~100-byte ack for the whole run
+                    _, idx, slot, off, items = task
+                    t0 = time.perf_counter()
+                    client.write_batch(slot, off, [fn(it) for it in items],
+                                       wire)
+                    dur = time.perf_counter() - t0
+                    conn.send(("okshmb", idx, len(items), dur))
                 else:  # "task"
                     _, idx, slot, off, item = task
                     t0 = time.perf_counter()
@@ -156,15 +182,17 @@ class _Worker:
 
 
 class _InFlight:
-    __slots__ = ("wid", "chunk", "off", "slot", "item", "probe")
+    __slots__ = ("wid", "chunk", "off", "slot", "item", "probe", "batch")
 
-    def __init__(self, wid, chunk, off, slot, item, probe=False):
+    def __init__(self, wid, chunk, off, slot, item, probe=False,
+                 batch=False):
         self.wid = wid
         self.chunk = chunk
         self.off = off
         self.slot = slot
-        self.item = item
+        self.item = item  # one item, or the item list when batch=True
         self.probe = probe
+        self.batch = batch
 
 
 class ProcessPoolMap:
@@ -193,8 +221,17 @@ class ProcessPoolMap:
         self._source = source
         self._fn = fn
         self._workers_n = int(num_workers)
-        self._buf = int(buffer_size if buffer_size is not None
-                        else 2 * num_workers)
+        if buffer_size is not None:
+            self._buf = int(buffer_size)
+        elif chunk is not None:
+            # fused shm mode: memory is bounded by the ring, not the
+            # ticket count, so read ahead deep enough that every ring
+            # slot's chunk can be assembling at once (depth-aware)
+            self._buf = int(get_flag("datapipe_readahead")
+                            or max(2 * num_workers,
+                                   int(ring_slots) * int(chunk)))
+        else:
+            self._buf = 2 * int(num_workers)
         if self._buf < num_workers:
             raise ValueError(
                 f"buffer_size {self._buf} < num_workers {num_workers} "
@@ -309,6 +346,29 @@ class ProcessPoolMap:
         self._active = state
         wid_seq = [0]
 
+        def pin_worker(proc, wid):
+            """FLAGS_datapipe_pin_workers: bind the worker to one core of
+            the parent's affinity mask, round-robin, keeping the parent's
+            current core for last so decode doesn't contend with dispatch
+            when there are cores to spare."""
+            if not get_flag("datapipe_pin_workers"):
+                return
+            if not hasattr(os, "sched_setaffinity"):
+                return
+            try:
+                cpus = sorted(os.sched_getaffinity(0))
+                if len(cpus) < 2:
+                    return  # single core: pinning only hurts
+                try:
+                    own = os.sched_getcpu()
+                except (AttributeError, OSError):
+                    own = None
+                if own in cpus and len(cpus) > self._workers_n:
+                    cpus = [c for c in cpus if c != own] + [own]
+                os.sched_setaffinity(proc.pid, {cpus[wid % len(cpus)]})
+            except OSError:
+                pass  # containers may forbid affinity changes
+
         def spawn_worker():
             wid = wid_seq[0]
             wid_seq[0] += 1
@@ -319,6 +379,7 @@ class ProcessPoolMap:
                 daemon=True, name=f"datapipe-proc-{wid}")
             proc.start()
             w_conn.close()  # parent keeps only the read end
+            pin_worker(proc, wid)
             w = _Worker(wid, proc, task_q, r_conn)
             if state["ring"] is not None:
                 w.task_q.put(("ring", state["ring"].meta(), state["wire"]))
@@ -373,6 +434,9 @@ class ProcessPoolMap:
                     tgt.outstanding.add(idx)
                     if rec.probe:
                         tgt.task_q.put(("probe", idx, rec.item))
+                    elif rec.batch:
+                        tgt.task_q.put(("taskb", idx, rec.slot, rec.off,
+                                        rec.item))
                     else:
                         tgt.task_q.put(("task", idx, rec.slot, rec.off,
                                         rec.item))
@@ -445,6 +509,40 @@ class ProcessPoolMap:
         def dispatch_loop():
             src = iter(self._source)
             cur_chunk, cur_off, cur_slot = 0, 0, None
+            disp_b = 1
+            if fused:
+                # coalesced dispatch: B items per queue/pipe round-trip.
+                # Auto splits each chunk evenly over the pool so no worker
+                # idles while another decodes a whole chunk.
+                disp_b = int(get_flag("datapipe_dispatch_batch")) \
+                    or max(1, K // max(1, self._workers_n))
+            pending = []  # [(idx, item)] of the assembling coalesced run
+
+            def flush_run():
+                """Ship the pending run as one taskb message. False when
+                no worker is alive to take it (error already set)."""
+                nonlocal pending
+                if not pending:
+                    return True
+                w = pick_worker()
+                if w is None:
+                    return False
+                from ..resilience import chaos
+
+                idx0 = pending[0][0]
+                items = [it for _, it in pending]
+                off0 = cur_off - len(pending)
+                for i, _ in pending:
+                    chaos.on_map_dispatch(i, w.proc.pid)
+                with cond:
+                    state["inflight"][idx0] = _InFlight(
+                        w.wid, cur_chunk, off0, cur_slot, items,
+                        batch=True)
+                    w.outstanding.add(idx0)
+                w.task_q.put(("taskb", idx0, cur_slot, off0, items))
+                pending = []
+                return True
+
             try:
                 while not (state["stop"] or state["error"] is not None):
                     scan_deaths()
@@ -500,6 +598,10 @@ class ProcessPoolMap:
                         st.add_wait_in(time.perf_counter() - t0)
                     if item is _End:
                         tickets.release()
+                        # flush the partial run first: its rows must be
+                        # acked for the consumer's tail-drop accounting
+                        if fused and not flush_run():
+                            return
                         with cond:
                             state["eof_at"] = state["next_in"]
                             cond.notify_all()
@@ -522,6 +624,17 @@ class ProcessPoolMap:
                             state["probe_sent"] = True
                         w.task_q.put(("probe", idx, item))
                         continue
+                    if fused:
+                        pending.append((idx, item))
+                        cur_off += 1
+                        if len(pending) >= disp_b or cur_off == K:
+                            if not flush_run():
+                                return
+                        if cur_off == K:
+                            cur_chunk += 1
+                            cur_off = 0
+                            cur_slot = None
+                        continue
                     w = pick_worker()
                     if w is None:
                         tickets.release()
@@ -529,19 +642,11 @@ class ProcessPoolMap:
                     from ..resilience import chaos
 
                     chaos.on_map_dispatch(idx, w.proc.pid)
-                    slot = cur_slot if fused else None
-                    off = cur_off if fused else 0
                     with cond:
                         state["inflight"][idx] = _InFlight(
-                            w.wid, cur_chunk, off, slot, item)
+                            w.wid, cur_chunk, 0, None, item)
                         w.outstanding.add(idx)
-                    w.task_q.put(("task", idx, slot, off, item))
-                    if fused:
-                        cur_off += 1
-                        if cur_off == K:
-                            cur_chunk += 1
-                            cur_off = 0
-                            cur_slot = None
+                    w.task_q.put(("task", idx, None, 0, item))
             except BaseException as e:  # pragma: no cover - defensive
                 fail(e)
             finally:
@@ -583,30 +688,31 @@ class ProcessPoolMap:
                         st.add_item(busy_s=dur)
                     cond.notify_all()
                     return
-                state["acked"] += 1
-                dur = msg[-1] if kind == "okshm" else msg[3]
+                n_items = msg[2] if kind == "okshmb" else 1
+                state["acked"] += n_items
+                dur = msg[2] if kind == "okshm" else msg[3]
                 if kind == "ok":
                     res = msg[2]
                     if self._order:
                         done[idx] = res
                     else:
                         ready.append(res)
-                else:  # okshm
+                else:  # okshm / okshmb
                     c = rec.chunk
                     state["chunk_acks"][c] = \
-                        state["chunk_acks"].get(c, 0) + 1
-                    tickets.release()
+                        state["chunk_acks"].get(c, 0) + n_items
+                    tickets.release(n_items)
                 if st:
                     nb = 0
-                    if kind == "okshm":
+                    if kind in ("okshm", "okshmb"):
                         if row_bytes[0] is None and state["ring"]:
                             sch = state["ring"].schema
                             row_bytes[0] = sum(
                                 int(np.prod(s[1:], dtype=np.int64))
                                 * np.dtype(d).itemsize
                                 for s, d in sch.values())
-                        nb = row_bytes[0] or 0
-                    st.add_item(busy_s=dur, nbytes=nb)
+                        nb = (row_bytes[0] or 0) * n_items
+                    st.add_item(busy_s=dur, nbytes=nb, count=n_items)
                 if tracing:
                     _trace.record("datapipe.pmap", recv_t - dur, recv_t,
                                   kind="datapipe", attrs={"idx": idx})

@@ -26,6 +26,14 @@ Ownership protocol:
 Segment names carry the `ptpipe_` prefix so leaked segments are greppable
 in /dev/shm; a module-level registry (`live_segments()`) backs the
 no-leak pytest fixture and the green-gate smoke.
+
+Super-slot coalescing: logical slots are packed `coalesce` per POSIX
+segment (one mmap + one /dev/shm inode per SUPER-slot instead of per
+chunk), at 64-byte-aligned strides. Fewer segments means fewer attach
+mmaps in every worker, fewer page-table entries, and bigger contiguous
+regions for the kernel to fault in — the "larger chunks" half of the
+unthrottled staging path. The acquire/release protocol is unchanged:
+slots stay the unit of ownership, only their backing storage is shared.
 """
 
 import os
@@ -100,25 +108,45 @@ class SlotLease:
         return f"SlotLease(slot={self.slot}, released={self._done})"
 
 
-class ShmRing:
-    """Parent-side ring of `slots` shared-memory segments, each holding
-    the arrays of `schema` ({name: (shape, dtype)})."""
+def _auto_coalesce(slots, stride):
+    """Chunks packed per segment: as many as fit in ~8 MB (but never more
+    than the ring has), so small-chunk rings collapse to one segment while
+    image-scale chunks keep one segment each."""
+    cap = max(1, (8 << 20) // max(stride, 1))
+    return max(1, min(int(slots), cap))
 
-    def __init__(self, slots, schema, name_hint="ring"):
+
+class ShmRing:
+    """Parent-side ring of `slots` logical shared-memory slots, each
+    holding the arrays of `schema` ({name: (shape, dtype)}), packed
+    `coalesce` slots per POSIX segment (super-slots)."""
+
+    def __init__(self, slots, schema, name_hint="ring", coalesce=None):
         from multiprocessing import shared_memory
 
         if int(slots) < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         self.schema = _normalize_schema(schema)
         self._offsets, self._size = _layout(self.schema)
+        # slot stride inside a super-slot segment, aligned so every
+        # slot's first array stays 64-byte-aligned for zero-copy puts
+        self._stride = (self._size + _ALIGN - 1) // _ALIGN * _ALIGN
+        if coalesce is None:
+            coalesce = _auto_coalesce(slots, self._stride)
+        self._coalesce = max(1, min(int(coalesce), int(slots)))
+        self._n_slots = int(slots)
+        n_segs = (self._n_slots + self._coalesce - 1) // self._coalesce
         self._segs = []
         self._names = []
-        for i in range(int(slots)):
+        for i in range(n_segs):
             _seq[0] += 1
+            # slots in the tail segment: may be fewer than `coalesce`
+            n_here = min(self._coalesce,
+                         self._n_slots - i * self._coalesce)
             name = (f"{SEGMENT_PREFIX}_{os.getpid()}_{_seq[0]}_"
                     f"{name_hint}_{i}")
-            seg = shared_memory.SharedMemory(name=name, create=True,
-                                             size=self._size)
+            seg = shared_memory.SharedMemory(
+                name=name, create=True, size=self._stride * n_here)
             _register(seg.name)
             self._segs.append(seg)
             self._names.append(seg.name)
@@ -129,16 +157,25 @@ class ShmRing:
 
     @property
     def slots(self):
+        return self._n_slots
+
+    @property
+    def coalesce(self):
+        return self._coalesce
+
+    @property
+    def segments(self):
         return len(self._names)
 
     @property
     def nbytes(self):
-        return self._size * len(self._names)
+        return self._stride * self._n_slots
 
     def meta(self):
         """Picklable attach info for ShmRingClient in worker processes."""
         return {"names": list(self._names), "schema": dict(self.schema),
-                "offsets": dict(self._offsets)}
+                "offsets": dict(self._offsets),
+                "coalesce": self._coalesce, "stride": self._stride}
 
     # -- slot pool (parent threads only) --------------------------------
     def acquire(self, timeout=0.2):
@@ -162,10 +199,12 @@ class ShmRing:
 
     def views(self, slot):
         """{name: ndarray} views over one slot's buffer (no copies)."""
-        buf = self._segs[slot].buf
+        seg_i, lane = divmod(slot, self._coalesce)
+        buf = self._segs[seg_i].buf
+        base = lane * self._stride
         out = {}
         for name, (shape, dtype) in self.schema.items():
-            off = self._offsets[name]
+            off = base + self._offsets[name]
             out[name] = np.ndarray(shape, dtype=dtype, buffer=buf,
                                    offset=off)
         return out
@@ -232,26 +271,31 @@ class ShmRingClient:
         self._schema = {n: (tuple(s), d)
                         for n, (s, d) in meta["schema"].items()}
         self._offsets = dict(meta["offsets"])
+        # pre-coalescing parents (older meta) map one slot per segment
+        self._coalesce = int(meta.get("coalesce", 1))
+        self._stride = int(meta.get("stride", 0))
         self._segs = {}
 
-    def _seg(self, slot):
-        seg = self._segs.get(slot)
+    def _seg(self, seg_i):
+        seg = self._segs.get(seg_i)
         if seg is None:
-            path = f"/dev/shm/{self._names[slot]}"
+            path = f"/dev/shm/{self._names[seg_i]}"
             if os.path.exists(path):
                 seg = _MMapSeg(path)
             else:  # platforms without /dev/shm, tracker quirks and all
                 from multiprocessing import shared_memory
 
-                seg = shared_memory.SharedMemory(name=self._names[slot])
-            self._segs[slot] = seg
+                seg = shared_memory.SharedMemory(name=self._names[seg_i])
+            self._segs[seg_i] = seg
         return seg
 
     def views(self, slot):
-        buf = self._seg(slot).buf
+        seg_i, lane = divmod(slot, self._coalesce)
+        buf = self._seg(seg_i).buf
+        base = lane * self._stride
         out = {}
         for name, (shape, dtype) in self._schema.items():
-            off = self._offsets[name]
+            off = base + self._offsets[name]
             out[name] = np.ndarray(shape, dtype=dtype, buffer=buf,
                                    offset=off)
         return out
@@ -266,6 +310,18 @@ class ShmRingClient:
             if wire is not None and name in wire:
                 v = wire[name].encode(v)
             view[index] = v
+
+    def write_batch(self, slot, index0, values_list, wire=None):
+        """write() for a run of consecutive rows starting at `index0`,
+        constructing each slot view ONCE instead of per item — the hot
+        loop of coalesced (taskb) dispatch."""
+        views = self.views(slot)
+        for name, view in views.items():
+            enc = wire[name].encode if wire is not None and name in wire \
+                else None
+            for j, values in enumerate(values_list):
+                v = values[name]
+                view[index0 + j] = enc(v) if enc is not None else v
 
     def close(self):
         for seg in self._segs.values():

@@ -36,10 +36,12 @@ class StageStats:
         self._t_last = None
 
     # -- recording -----------------------------------------------------
-    def add_item(self, busy_s=0.0, nbytes=0):
+    def add_item(self, busy_s=0.0, nbytes=0, count=1):
+        """Record `count` items finished in one go (coalesced dispatch
+        acks a whole run at once) with their combined busy time/bytes."""
         now = time.perf_counter()
         with self._lock:
-            self.items += 1
+            self.items += int(count)
             self.bytes += int(nbytes)
             self.busy_s += busy_s
             if self._t_first is None:
@@ -143,6 +145,9 @@ class PipeStats:
         bn = self._bottleneck(out)
         if bn is not None:
             out["bottleneck_stage"] = bn
+        lane = self._bottleneck_lane(out)
+        if lane is not None:
+            out["bottleneck_lane"] = lane
         return out
 
     @staticmethod
@@ -158,6 +163,19 @@ class PipeStats:
             if d["busy_s"] > best_busy:
                 best, best_busy = name, d["busy_s"]
         return best
+
+    @staticmethod
+    def _bottleneck_lane(snap):
+        """The busiest transfer LANE when more than one moved data. The
+        aggregate `transfer` row merges every lane's busy-ms, which used
+        to attribute a slow second stream to link0; this names the actual
+        slow lane so a stuck transfer thread is visible per-lane."""
+        lanes = [(name, d) for name, d in snap.items()
+                 if isinstance(d, dict) and name.startswith("link")
+                 and d.get("items", 0) > 0]
+        if len(lanes) < 2:
+            return None
+        return max(lanes, key=lambda nd: nd[1].get("busy_s", 0.0))[0]
 
     _DELTA_KEYS = ("items", "bytes", "busy_s", "wait_in_s", "wait_out_s",
                    "bp_wait_s")
@@ -181,6 +199,9 @@ class PipeStats:
         bn = self._bottleneck(out)
         if bn is not None:
             out["bottleneck_stage"] = bn
+        lane = self._bottleneck_lane(out)
+        if lane is not None:
+            out["bottleneck_lane"] = lane
         from .. import monitor
 
         if monitor.enabled():

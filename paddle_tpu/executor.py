@@ -259,6 +259,24 @@ class Executor:
         self.place = place if place is not None else TPUPlace(0)
         self._compile_cache = CompileCache("executor")
         self._step_counter = {}
+        self._fusion_cache = {}
+
+    def _fuse_program(self, program, feed_names, fetch_names):
+        """FLAGS_fuse: resolve (and cache) the fused clone of `program`
+        (paddle_tpu.fusion). Cached per (id, mutation, bucket budget,
+        feeds, fetches) so repeat steps reuse ONE clone — a stable clone
+        id keeps the compile-cache key stable."""
+        from . import fusion
+
+        key = (id(program), program._mutation,
+               flags.get("fuse_bucket_mb"),
+               tuple(sorted(feed_names)), tuple(fetch_names))
+        hit = self._fusion_cache.get(key)
+        if hit is None:
+            hit = fusion.apply(program, feed_names=feed_names,
+                               fetch_names=fetch_names)
+            self._fusion_cache[key] = hit
+        return hit
 
     def _device_scope(self):
         """Pin execution to the Place's device (executor.cc:133 runs ops on
@@ -451,6 +469,10 @@ class Executor:
                 feed_vals = self._feed_values(program, feed, wire=wire)
         else:
             feed_vals = self._feed_values(program, feed, wire=wire)
+        fplan = None
+        if flags.get("fuse"):
+            program, fplan = self._fuse_program(
+                program, list(feed_vals), list(fetch_names))
         state_names, state_out_names = executor_core.collect_state_names(program, scope)
         if flags.get("debug_nans"):
             donate_feeds = False  # re-run needs the inputs (see below)
@@ -467,6 +489,7 @@ class Executor:
             ("wire", wire.fingerprint() if wire is not None else None),
             ("donate_feeds", donate_feeds),
             ("health", hplan.digest if hplan is not None else None),
+            ("fuse", fplan.digest() if fplan is not None else None),
         )
         entry = self._compile_cache.get(cache_key) if use_cache else None
         fp = monitor.fingerprint_of(cache_key) if mon is not None else None
@@ -620,6 +643,10 @@ class Executor:
                 feed_vals = self._stack_feeds(program, feed, iters, wire=wire)
         else:
             feed_vals = self._stack_feeds(program, feed, iters, wire=wire)
+        fplan = None
+        if flags.get("fuse"):
+            program, fplan = self._fuse_program(
+                program, list(feed_vals), list(fetch_names))
         state_names, state_out_names = executor_core.collect_state_names(
             program, scope)
         missing = [n for n in state_out_names if not scope.has_var(n)]
@@ -648,6 +675,7 @@ class Executor:
             ("wire", wire.fingerprint() if wire is not None else None),
             ("donate_feeds", donate_feeds),
             ("health", hplan.digest if hplan is not None else None),
+            ("fuse", fplan.digest() if fplan is not None else None),
         )
         out_set = set(state_out_names)
         mut_state, const_state = {}, {}

@@ -151,6 +151,10 @@ class ParallelExecutor:
         # their ScheduleReport, keyed on (program identity, mutation,
         # bucket bytes); strong refs keep id() stable for the compile cache
         self._overlap_cache = {}
+        # fused clones of the resolved program + their FusionPlan, keyed
+        # on (program identity, mutation, bucket budget, fetches); strong
+        # refs keep id() stable for the compile cache
+        self._fusion_cache = {}
         self._step = 0
         self.num_trainers = num_trainers
         self.trainer_id = trainer_id
@@ -242,6 +246,25 @@ class ParallelExecutor:
                 program, sched.plan, feed_names=feed_names)
             hit = (reordered, sched)
             self._overlap_cache[key] = hit
+        return hit
+
+    def _fuse_program(self, program, feed_names, fetch_names):
+        """Apply cost-guided fusion (paddle_tpu.fusion) to the RESOLVED
+        program — after zero1 and overlap, so optimizer buckets see the
+        final shard-layout wiring, and before autoshard, so the fused
+        ops' operands inherit the plan like any other op. Returns
+        (program', FusionPlan or None); cached per (program identity,
+        mutation, bucket budget, feeds, fetches)."""
+        from . import fusion
+
+        key = (id(program), program._mutation,
+               int(flags.get("fuse_bucket_mb")),
+               tuple(sorted(feed_names or [])), tuple(fetch_names))
+        hit = self._fusion_cache.get(key)
+        if hit is None:
+            hit = fusion.apply(program, feed_names=feed_names,
+                               fetch_names=fetch_names)
+            self._fusion_cache[key] = hit
         return hit
 
     def _autoshard_plan(self, program):
@@ -389,6 +412,15 @@ class ParallelExecutor:
             program, osched = self._overlap_program(
                 program,
                 feed_names=list(feed) if isinstance(feed, dict) else None)
+        # cost-guided fusion (FLAGS_fuse): after zero1/overlap so buckets
+        # see the final wiring, before autoshard so fused operands get
+        # plan layouts like any other op. Digest joins the cache key.
+        fplan = None
+        if flags.get("fuse"):
+            program, fplan = self._fuse_program(
+                program,
+                feed_names=list(feed) if isinstance(feed, dict) else [],
+                fetch_names=fetch_names)
         use_autoshard = bs.auto_sharding
         if use_autoshard is None:
             use_autoshard = bool(flags.get("autoshard"))
@@ -521,6 +553,7 @@ class ParallelExecutor:
             ("overlap",
              osched.plan.digest() if osched is not None else None),
             ("autoshard", aplan.digest() if aplan is not None else None),
+            ("fuse", fplan.digest() if fplan is not None else None),
             ("health", hplan.digest if hplan is not None else None),
             # stage programs from parallel.pipeline share var names with
             # each other and the source program; the (plan digest, stage,

@@ -126,6 +126,29 @@ def _estimate(block, op, batch):
     if t in ("lookup_table", "gather", "concat", "split", "transpose",
              "reshape", "squeeze", "unsqueeze", "cast", "scale", "pad"):
         return float(out_elems)
+    if t in ("pipeline_send", "pipeline_recv", "zero1_gather",
+             "all_gather", "broadcast"):
+        # pure data movement (ICI): attribute the moved elements
+        return float(out_elems)
+    if t in ("zero1_scatter", "all_reduce", "reduce_scatter"):
+        # ring reduction: ~one add per input element around the ring
+        ins = op.input("X") or op.input_arg_names()[:1]
+        in_shape = _shape_of(block, ins[0], batch) if ins else None
+        return float(_numel(in_shape, batch)) if in_shape is not None \
+            else float(out_elems)
+    if t == "fused_elementwise":
+        # the collapsed chain does every sub-op's arithmetic in one pass
+        subs = op.attrs.get("sub_types") or ()
+        return sum(_ELEM_WEIGHTS.get(s, 1.0) for s in subs) * out_elems
+    if t in ("fused_sgd_update", "fused_momentum_update",
+             "fused_adam_update"):
+        # per-element update cost x total bucket payload
+        per = {"fused_sgd_update": 2.0, "fused_momentum_update": 5.0,
+               "fused_adam_update": 12.0}[t]
+        total = 0.0
+        for nm in (op.input("Param") or []):
+            total += _numel(_shape_of(block, nm, batch), batch)
+        return per * max(1.0, total)
     if t.endswith("_grad"):
         # grad ops roughly mirror the forward cost for input grads plus
         # a comparable pass for parameter grads
