@@ -407,3 +407,18 @@ def record_gauges(sched_report, context=None):
         "overlap_bucket_count",
         help="number of gradient buckets in the overlap plan",
     ).set(float(len(sched_report.plan.buckets)))
+    # the analytic compute/comm split: the fleet collector joins these
+    # with the measured step time into fleet_overlap_efficiency (comm
+    # hidden under compute — obs/timeline.overlap_efficiency)
+    reg.gauge(
+        "dataflow_serial_ms",
+        help="analytic serial cost of the whole step (compute + comm)",
+    ).set(float(sched_report.serial_ms))
+    reg.gauge(
+        "dataflow_compute_ms",
+        help="analytic compute share of the serial step cost",
+    ).set(float(sched_report.compute_ms))
+    reg.gauge(
+        "dataflow_comm_ms",
+        help="analytic collective share of the serial step cost",
+    ).set(float(sched_report.comm_ms))

@@ -106,21 +106,82 @@ def _cmd_flags(args):
 
 
 def _cmd_monitor(args):
+    import glob as globmod
+
     from .monitor import format_summary, read_journal, summarize_journal
 
-    try:
-        records = read_journal(args.journal)
-    except OSError as e:
-        print(f"cannot read journal: {e}", file=sys.stderr)
-        return 1
-    summary = summarize_journal(records)
-    if args.json:
-        import json
+    paths = []
+    for pat in args.journal:
+        hits = sorted(globmod.glob(pat))
+        paths.extend(hits or [pat])
+    journals = {}
+    for path in paths:
+        if path in journals:
+            continue
+        try:
+            journals[path] = read_journal(path)
+        except OSError as e:
+            print(f"cannot read journal: {e}", file=sys.stderr)
+            return 1
+    if len(journals) == 1:
+        summary = summarize_journal(next(iter(journals.values())))
+        if args.json:
+            import json
 
-        print(json.dumps(summary, indent=2))
+            print(json.dumps(summary, indent=2))
+        else:
+            print(format_summary(summary))
+        return 0
+
+    # several journals = one per fleet process: per-process summaries
+    # plus the obs clock-aligned merge (same-host processes share the
+    # epoch clock, so offset 0 per journal) for cross-replica skew
+    import json
+    import os as osmod
+
+    from .obs import merge_step_timeline
+
+    summaries = {p: summarize_journal(r) for p, r in journals.items()}
+    merged = merge_step_timeline(
+        [{"name": osmod.path.basename(p) or p, "journal": r,
+          "offset_s": 0.0} for p, r in journals.items()])
+    if args.json:
+        print(json.dumps({"journals": summaries,
+                          "fleet": {k: merged[k] for k in
+                                    ("steps", "stragglers")}}, indent=2))
+        return 0
+    hdr = (f"{'journal':<28}{'steps':>7}{'mean_ms':>10}{'p50_ms':>9}"
+           f"{'p95_ms':>9}{'cache_hit%':>11}")
+    print(hdr)
+    print("-" * len(hdr))
+    for path, s in summaries.items():
+        ms = s.get("step_ms") or {}
+        cache = s.get("cache") or {}
+        lookups = (cache.get("hit") or 0) + (cache.get("miss") or 0)
+        hit = 100.0 * (cache.get("hit") or 0) / lookups if lookups \
+            else None
+        print(f"{osmod.path.basename(path) or path:<28.27}"
+              f"{s.get('steps', 0):>7}"
+              f"{_opt_num(ms.get('mean')):>10}"
+              f"{_opt_num(ms.get('p50')):>9}"
+              f"{_opt_num(ms.get('p95')):>9}"
+              f"{_opt_num(hit):>11}")
+    steps = merged["steps"]
+    if steps:
+        worst = max(steps, key=lambda s: s["skew_ms"])
+        print(f"fleet: {len(steps)} step(s) aligned across processes; "
+              f"max skew {worst['skew_ms']:.1f} ms at step "
+              f"{worst['step']} (slowest {worst['slowest']})")
+        for name, run in sorted(merged["stragglers"].items()):
+            print(f"straggler: {name} slowest on {run} consecutive "
+                  f"step(s)")
     else:
-        print(format_summary(summary))
+        print("fleet: no step overlap between the journals")
     return 0
+
+
+def _opt_num(v, spec="{:.1f}"):
+    return "-" if v is None else spec.format(v)
 
 
 def _cmd_health(args):
@@ -1043,12 +1104,14 @@ def _cmd_fleet_replica(args):
             monkey.add(chaos.Fault("replica_kill", at=args.chaos_kill_at))
         if args.chaos_hang_at is not None:
             monkey.add(chaos.Fault("replica_hang", at=args.chaos_hang_at,
+                                   times=args.chaos_hang_times,
                                    delay_ms=args.chaos_hang_ms))
         chaos.install(monkey)
     place = CPUPlace() if args.place == "cpu" else TPUPlace(0)
     config = ServeConfig(
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
-        replicas=args.replicas, max_queue_rows=args.max_queue_rows)
+        replicas=args.replicas, max_queue_rows=args.max_queue_rows,
+        slo_ms=args.slo_ms)
     try:
         server = Server.from_inference_model(
             args.model_dir, place=place, config=config)
@@ -1067,6 +1130,13 @@ def _cmd_fleet_replica(args):
         with open(args.port_file, "w") as f:
             f.write(f"{port}\n")
     print(f"replica {name} serving on {endpoint}", file=sys.stderr)
+
+    obs_client = None
+    if args.obs:
+        from . import obs as obs_mod
+
+        obs_client = obs_mod.maybe_start("replica", replica=name,
+                                         endpoint=args.obs)
 
     heartbeater = None
     if args.master:
@@ -1122,6 +1192,10 @@ def _cmd_fleet_replica(args):
             heartbeater.close()
     stats = server.stats()
     server.stop()
+    if obs_client is not None:
+        # final push AFTER stop: the collector sees the terminal journal
+        # tail and any shutdown trace dump
+        obs_client.stop()
     leftover = stats["queue_rows"]
     print(f"replica {name} exiting: drained queue_rows={leftover}",
           file=sys.stderr)
@@ -1150,10 +1224,17 @@ def _cmd_fleet_router(args):
         attempt_timeout_ms=args.attempt_timeout_ms,
         max_attempts=args.max_attempts, hedge_ms=args.hedge_ms)
     router = Router(replicas, config=config, discover=discover)
+    obs_client = None
+    if args.obs:
+        from . import obs as obs_mod
+
+        obs_client = obs_mod.maybe_start("router", endpoint=args.obs)
     print(f"fleet router on {args.host}:{args.port} over "
           f"{sorted(replicas.values()) or 'master-discovered replicas'}",
           file=sys.stderr)
     serve_fleet(router, host=args.host, port=args.port)
+    if obs_client is not None:
+        obs_client.stop()
     return 0
 
 
@@ -1200,6 +1281,116 @@ def _cmd_fleet(args):
         return _cmd_fleet_replica(args)
     if args.fleet_action == "router":
         return _cmd_fleet_router(args)
+    return 1
+
+
+def _cmd_obs(args):
+    import json
+
+    from . import obs as obs_mod
+
+    if args.obs_action == "collect":
+        import threading
+
+        col = obs_mod.Collector(ttl_s=args.ttl,
+                                straggler_ratio=args.straggler_ratio,
+                                straggler_steps=args.straggler_steps)
+        for target in args.scrape or []:
+            name, _, endpoint = target.rpartition("=")
+            col.add_scrape_target(name or endpoint, endpoint)
+        httpd = obs_mod.make_obs_http(col, host=args.host, port=args.port)
+        port = httpd.server_address[1]
+        if args.port_file:
+            with open(args.port_file, "w") as f:
+                f.write(f"{port}\n")
+        print(f"obs collector on {args.host}:{port} "
+              f"(POST /v1/obs/push, GET /metrics /v1/obs/summary "
+              f"/v1/obs/timeline; {len(args.scrape or [])} scrape "
+              f"target(s))", file=sys.stderr)
+        stop = threading.Event()
+        if args.scrape:
+            col.scrape_tick()
+
+            def _scrape_loop():
+                while not stop.wait(args.scrape_interval):
+                    col.scrape_tick()
+
+            threading.Thread(target=_scrape_loop, name="obs-scrape",
+                             daemon=True).start()
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            stop.set()
+            httpd.server_close()
+        return 0
+
+    if args.obs_action == "top":
+        return obs_mod.run_top(
+            args.collector, interval_s=args.interval, once=args.once,
+            json_out=args.json, iterations=args.iterations)
+
+    if args.obs_action == "timeline":
+        from .trace import load_dump
+
+        dump_dirs = []        # [(lane name or None, dir)]
+        merged_steps = None
+        if args.collector:
+            import http.client
+
+            try:
+                host, port = args.collector.rsplit(":", 1)
+                conn = http.client.HTTPConnection(host, int(port),
+                                                  timeout=5.0)
+                try:
+                    conn.request("GET", "/v1/obs/timeline")
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    if resp.status != 200:
+                        raise OSError(f"HTTP {resp.status}")
+                finally:
+                    conn.close()
+                tl = json.loads(body)
+            except (OSError, ValueError) as e:
+                print(f"cannot reach collector {args.collector}: {e}",
+                      file=sys.stderr)
+                return 2
+            merged_steps = tl.get("timeline")
+            dump_dirs.extend((d.get("replica"), d["dir"])
+                             for d in tl.get("dumps", []))
+        for d in args.dump or []:
+            dump_dirs.append((None, d))
+        if not dump_dirs and merged_steps is None:
+            print("obs timeline needs --collector and/or --dump",
+                  file=sys.stderr)
+            return 2
+        dumps, names = [], []
+        for lane, d in dump_dirs:
+            try:
+                dumps.append(load_dump(d))
+            except (OSError, ValueError) as e:
+                print(f"skipping dump {d}: {e}", file=sys.stderr)
+                continue
+            names.append(lane or os.path.basename(d.rstrip("/")))
+        if merged_steps is not None:
+            print(obs_mod.format_timeline(merged_steps))
+        if dumps:
+            trace = obs_mod.merge_chrome_traces(dumps, names=names)
+            lanes = {e['pid'] for e in trace['traceEvents']}
+            print(f"merged trace: {len(dumps)} dump(s), "
+                  f"{len(lanes)} pid lane(s), "
+                  f"{sum(1 for e in trace['traceEvents'] if e['ph'] == 'X')}"
+                  f" span event(s)")
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump(trace, f)
+                print(f"wrote {args.out}")
+        elif args.out:
+            print("no trace dumps to merge (nothing written)",
+                  file=sys.stderr)
+            return 1
+        return 0
     return 1
 
 
@@ -1331,9 +1522,13 @@ def main(argv=None):
     sub.add_parser("version", help="print version and backend info")
     sub.add_parser("flags", help="list runtime flags")
 
-    m = sub.add_parser("monitor", help="summarize a step-journal file "
+    m = sub.add_parser("monitor", help="summarize step-journal files "
                                        "(FLAGS_monitor_journal)")
-    m.add_argument("journal", help="path of the JSONL step journal")
+    m.add_argument("journal", nargs="+",
+                   help="JSONL step journal path(s); globs OK. Several "
+                        "journals render a per-process comparison table "
+                        "plus the clock-aligned cross-replica skew/"
+                        "straggler merge")
     m.add_argument("--json", action="store_true",
                    help="emit the summary as JSON instead of a table")
 
@@ -1627,6 +1822,10 @@ def main(argv=None):
     fr.add_argument("--replicas", type=int, default=1,
                     help="engine executor replicas inside this process")
     fr.add_argument("--max-queue-rows", type=int, default=None)
+    fr.add_argument("--slo-ms", type=float, default=None,
+                    help="latency SLO; violations count "
+                         "serve_slo_violations_total and trigger "
+                         "flight-recorder dumps under FLAGS_trace")
     fr.add_argument("--router", default=None, metavar="HOST:PORT",
                     help="register with this fleet router over HTTP")
     fr.add_argument("--master", default=None, metavar="HOST:PORT",
@@ -1640,6 +1839,13 @@ def main(argv=None):
                     help="hang this replica on its Nth executor dispatch")
     fr.add_argument("--chaos-hang-ms", type=float, default=None,
                     help="hang duration (default: effectively forever)")
+    fr.add_argument("--chaos-hang-times", type=int, default=1,
+                    metavar="K",
+                    help="hang on K consecutive dispatches from "
+                         "--chaos-hang-at (straggler drills)")
+    fr.add_argument("--obs", default=None, metavar="HOST:PORT",
+                    help="push metrics/journal/trace snapshots to this "
+                         "obs collector (see `paddle_tpu obs collect`)")
     fr.add_argument("--cache-dir", default=None,
                     help="persistent compile-cache directory shared by "
                          "the fleet (FLAGS_compile_cache_dir): only the "
@@ -1661,6 +1867,59 @@ def main(argv=None):
     fo.add_argument("--max-attempts", type=int, default=3)
     fo.add_argument("--hedge-ms", type=float, default=None,
                     help="hedge a silent first attempt after this long")
+    fo.add_argument("--obs", default=None, metavar="HOST:PORT",
+                    help="push router metrics to this obs collector")
+
+    ob = sub.add_parser("obs", help="fleet-wide observability: collector "
+                                    "sink, live top table, merged "
+                                    "timeline")
+    obsub = ob.add_subparsers(dest="obs_action", required=True)
+    obc = obsub.add_parser("collect", help="run the fleet collector "
+                                           "(push sink + scrape poller + "
+                                           "aggregated /metrics)")
+    obc.add_argument("--host", default="127.0.0.1")
+    obc.add_argument("--port", type=int, default=9200,
+                     help="HTTP port (0 = ephemeral; see --port-file)")
+    obc.add_argument("--port-file", default=None,
+                     help="write the bound port here once listening")
+    obc.add_argument("--ttl", type=float, default=None,
+                     help="stale-process expiry seconds "
+                          "(default FLAGS_obs_ttl_s)")
+    obc.add_argument("--scrape", action="append", default=None,
+                     metavar="[NAME=]HOST:PORT",
+                     help="poll this /metrics exposition as a fleet "
+                          "member (repeatable)")
+    obc.add_argument("--scrape-interval", type=float, default=2.0)
+    obc.add_argument("--straggler-ratio", type=float, default=1.2,
+                     help="slowest/median step-time ratio that counts "
+                          "toward straggler attribution")
+    obc.add_argument("--straggler-steps", type=int, default=3,
+                     help="consecutive slowest steps before "
+                          "fleet_straggler{replica=} fires")
+    obt = obsub.add_parser("top", help="live fleet table over the "
+                                       "collector summary (redraws in "
+                                       "place on a TTY)")
+    obt.add_argument("--collector", required=True, metavar="HOST:PORT")
+    obt.add_argument("--interval", type=float, default=2.0)
+    obt.add_argument("--once", action="store_true",
+                     help="print one frame and exit")
+    obt.add_argument("--json", action="store_true",
+                     help="emit raw summary JSON frames")
+    obt.add_argument("--iterations", type=int, default=None,
+                     help=argparse.SUPPRESS)
+    obl = obsub.add_parser("timeline", help="merged fleet timeline: "
+                                            "cross-replica skew table + "
+                                            "one chrome trace with a pid "
+                                            "lane per process")
+    obl.add_argument("--collector", default=None, metavar="HOST:PORT",
+                     help="pull the step timeline and known dumps from "
+                          "this collector")
+    obl.add_argument("--dump", action="append", default=None,
+                     metavar="DIR",
+                     help="merge this flight-recorder dump directory "
+                          "(repeatable)")
+    obl.add_argument("--out", default=None,
+                     help="write the merged chrome trace JSON here")
 
     e = sub.add_parser("elastic", help="elastic training membership: "
                                        "status snapshot and manual drain")
@@ -1720,6 +1979,8 @@ def main(argv=None):
             return _cmd_trace(args)
         if args.command == "fleet":
             return _cmd_fleet(args)
+        if args.command == "obs":
+            return _cmd_obs(args)
         if args.command == "elastic":
             return _cmd_elastic(args)
         if args.command == "train":

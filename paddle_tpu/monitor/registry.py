@@ -247,6 +247,32 @@ class MetricsRegistry:
         """{series_name: value | histogram dict} for every metric."""
         return {m.series: m.snapshot() for m in self.metrics()}
 
+    def export(self):
+        """Structured series export for cross-process aggregation
+        (paddle_tpu.obs push payloads): one dict per metric carrying the
+        name, kind, HELP text and labels next to the value, so a remote
+        collector can re-emit a faithful exposition — including the
+        `# HELP`/`# TYPE` comment lines — without sharing this process's
+        registry objects. Histograms export their full snapshot
+        (cumulative buckets + count/sum/min/max), which merges across
+        processes by bucket-wise addition."""
+        out = []
+        for m in self.metrics():
+            d = {"name": m.name, "kind": m.kind, "help": m.help,
+                 "labels": dict(m.labels)}
+            if isinstance(m, Histogram):
+                snap = m.snapshot()
+                # JSON object keys are strings; normalize the bucket
+                # edges now so local and round-tripped exports compare
+                # equal at the collector
+                snap["buckets"] = {str(k): v
+                                   for k, v in snap["buckets"].items()}
+                d["hist"] = snap
+            else:
+                d["value"] = m.snapshot()
+            out.append(d)
+        return out
+
     def reset(self):
         """Drop every registered metric (tests / fresh sessions)."""
         with self._lock:

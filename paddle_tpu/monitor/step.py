@@ -283,7 +283,9 @@ def step_end(rec, iters=None, datapipe=None, replica_ms=None,
         _registry.histogram("step_phase_ms",
                             help="per-phase wall time within a step",
                             kind=rec.kind, phase=name).observe(ms)
-        _registry.gauge("last_phase_ms", kind=rec.kind, phase=name).set(ms)
+        _registry.gauge("last_phase_ms",
+                        help="per-phase wall time of the last step",
+                        kind=rec.kind, phase=name).set(ms)
 
     with _lock:
         _state["steps"] += 1

@@ -404,10 +404,19 @@ class MasterService:
         self.port = self._listener.getsockname()[1]
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
+        # fleet observability: push the master's registry (lease/member
+        # gauges) to a collector when FLAGS_obs_push is set; no-op else
+        from ..obs import maybe_start as _obs_start
+
+        self._obs_client = _obs_start("master")
         return self.port
 
     def stop(self):
         self._stop = True
+        obs_client = getattr(self, "_obs_client", None)
+        if obs_client is not None:
+            self._obs_client = None
+            obs_client.stop()
         with self._mu:
             self._snapshot_locked(force=True)
         try:

@@ -124,6 +124,11 @@ class ResilientRunner:
         @contextlib.contextmanager
         def _session():
             self._in_session = True
+            # fleet observability rides the session lifecycle: a no-op
+            # unless FLAGS_obs_push names a collector
+            from ..obs import maybe_start as _obs_start
+
+            obs_client = _obs_start("trainer")
             try:
                 if self.elastic is not None \
                         and not getattr(self.elastic, "_started", False):
@@ -139,6 +144,10 @@ class ResilientRunner:
                     self.elastic.stop()
                 if self.checkpoint is not None:
                     self.checkpoint.wait()
+                if obs_client is not None:
+                    # final push after the drain: the collector sees the
+                    # terminal journal tail and any shutdown trace dump
+                    obs_client.stop()
 
         return _session()
 

@@ -45,20 +45,23 @@ FORMAT = "paddle_tpu.trace/1"
 CHROME_PID = 1  # profiler host lane is pid 0, XLA device lanes pid 100+
 
 
-def chrome_events(spans, t0=None, pid=CHROME_PID):
+def chrome_events(spans, t0=None, pid=CHROME_PID,
+                  process_name="paddle_tpu trace", sort_index=1):
     """Spans -> chrome-trace event dicts ("X" complete events, one tid
     row per recording thread). `t0` sets the timeline origin in
     perf_counter seconds (defaults to the earliest span) — pass the
-    profiler's _trace_t0 to align with its host/device lanes."""
+    profiler's _trace_t0 to align with its host/device lanes. Fleet
+    merges (obs/timeline.py) pass a distinct pid + process_name per
+    process so lanes don't collide on the default pid 1."""
     if not spans:
         return []
     if t0 is None:
         t0 = min(s["t0"] for s in spans)
     events = [
         {"ph": "M", "pid": pid, "name": "process_name",
-         "args": {"name": "paddle_tpu trace"}},
+         "args": {"name": process_name}},
         {"ph": "M", "pid": pid, "name": "process_sort_index",
-         "args": {"sort_index": 1}},
+         "args": {"sort_index": sort_index}},
     ]
     for s in spans:
         args = {"trace": s["trace"], "span": s["span"]}

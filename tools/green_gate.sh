@@ -909,6 +909,154 @@ if [ $? -ne 0 ]; then
     exit 1
 fi
 
+# obs fleet drill: 3 real replica processes push metrics/journals/trace
+# dumps into one collector (--obs) while a chaos replica_hang makes r2 the
+# straggler — the aggregated /metrics must show all three replicas with
+# ZERO dropped snapshots, the fleet_straggler{replica="r2"} gauge must
+# fire, and `obs timeline` must produce one loadable merged chrome trace
+# with a distinct pid lane per process.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, subprocess, sys, tempfile, threading, time
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import cli, obs
+from paddle_tpu.serve.fleet import FleetConfig, Router
+
+tmp = tempfile.mkdtemp(prefix="obs_gate_")
+prog, startup = fluid.Program(), fluid.Program()
+with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=3)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(startup)
+model_dir = os.path.join(tmp, "model")
+with fluid.program_guard(prog, startup):
+    fluid.io.save_inference_model(model_dir, ["x"], [y], exe)
+
+col = obs.Collector(ttl_s=30.0, straggler_ratio=1.5, straggler_steps=3)
+httpd = obs.make_obs_http(col, port=0)
+cport = httpd.server_address[1]
+threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+procs, endpoints = [], {}
+try:
+    for i in range(3):
+        pf = os.path.join(tmp, f"port{i}")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   FLAGS_monitor="1", FLAGS_trace="1",
+                   FLAGS_monitor_journal=os.path.join(tmp, f"r{i}.jsonl"),
+                   FLAGS_trace_dump_dir=os.path.join(tmp, f"dumps{i}"),
+                   FLAGS_obs_push_interval_s="0.2")
+        cmd = [sys.executable, "-m", "paddle_tpu", "fleet", "replica",
+               "--model-dir", model_dir, "--place", "cpu",
+               "--port", "0", "--port-file", pf, "--name", f"r{i}",
+               "--obs", f"127.0.0.1:{cport}",
+               # every request violates this SLO -> each replica writes
+               # one flight-recorder dump for the merged-trace check
+               "--slo-ms", "0.001"]
+        if i == 2:
+            cmd += ["--chaos-hang-at", "4", "--chaos-hang-times", "12",
+                    "--chaos-hang-ms", "250"]
+        procs.append(subprocess.Popen(cmd, env=env,
+                                      stdout=subprocess.DEVNULL,
+                                      stderr=subprocess.DEVNULL))
+        deadline = time.time() + 120
+        while not os.path.exists(pf) and time.time() < deadline:
+            time.sleep(0.1)
+        with open(pf) as f:
+            endpoints[f"r{i}"] = f"127.0.0.1:{f.read().strip()}"
+
+    router = Router(endpoints, config=FleetConfig(probe_interval_s=0.2))
+    deadline = time.time() + 120
+    while router.membership.healthy_count() < 3 and time.time() < deadline:
+        router.prober.tick()
+        time.sleep(0.2)
+    assert router.membership.healthy_count() == 3
+
+    body = json.dumps({"inputs": {"x": [[1.0, 2.0, 3.0, 4.0]]}}).encode()
+    codes, lock = {}, threading.Lock()
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            status, _h, _b = router.route(body)
+            with lock:
+                codes[status] = codes.get(status, 0) + 1
+            if status != 200:
+                # backpressure (r2 is hanging): ease off, retry
+                time.sleep(0.05)
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    # drive load until the collector attributes the straggler (r2 hangs
+    # 250 ms on 12 consecutive dispatches from its 4th)
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        s = col.summary()
+        if s["fleet"]["stragglers"].get("r2", 0) >= 3 \
+                and len(s["processes"]) == 3:
+            break
+        time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    # load flowed (the loop exits as soon as the straggler is
+    # attributed, so the absolute count stays small on one core); only
+    # backpressure-shaped failures (503 overloaded / 504 deadline) are
+    # acceptable
+    assert codes.get(200, 0) > 0, codes
+    assert set(codes) <= {200, 503, 504}, codes
+
+    summary = col.summary()
+    text = col.exposition()
+    # every replica aggregates into the one collector...
+    assert len(summary["processes"]) == 3, summary["fleet"]
+    for r in ("r0", "r1", "r2"):
+        assert f'replica="{r}"' in text, f"{r} missing from /metrics"
+    # ...with zero dropped snapshots across the whole drill
+    assert summary["fleet"]["dropped_snapshots"] == 0, summary["fleet"]
+    assert summary["fleet"]["pushes"] > 3
+    # skew + straggler attribution on the merged step timeline
+    assert summary["fleet"]["stragglers"].get("r2", 0) >= 3, \
+        summary["fleet"]
+    assert 'fleet_straggler{replica="r2"} 1.0' in text
+    assert summary["fleet"]["max_skew_ms"] > 100.0, summary["fleet"]
+
+    # merged chrome trace via the CLI: one pid lane per process
+    trace_out = os.path.join(tmp, "merged_trace.json")
+    rc = cli.main(["obs", "timeline",
+                   "--collector", f"127.0.0.1:{cport}",
+                   "--out", trace_out])
+    assert rc == 0, rc
+    with open(trace_out) as f:
+        merged = json.load(f)
+    lanes = {e["pid"] for e in merged["traceEvents"]}
+    assert len(lanes) >= 2, f"expected distinct pid lanes, got {lanes}"
+    spans = sum(1 for e in merged["traceEvents"] if e["ph"] == "X")
+    assert spans > 0
+
+    router.stop()
+    print(f"obs fleet drill: ok (3 replicas aggregated, "
+          f"{int(summary['fleet']['pushes'])} pushes, 0 dropped, "
+          f"straggler r2 x{summary['fleet']['stragglers']['r2']}, "
+          f"max skew {summary['fleet']['max_skew_ms']:.0f} ms, "
+          f"{len(lanes)} trace lanes / {spans} spans)")
+finally:
+    httpd.shutdown()
+    httpd.server_close()
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=10)
+    import shutil
+    shutil.rmtree(tmp, ignore_errors=True)
+EOF
+if [ $? -ne 0 ]; then
+    echo "GATE: OBS FLEET DRILL RED — do not commit" >&2
+    exit 1
+fi
+
 # elastic chaos drill: 4 REAL trainer processes on one elastic membership,
 # SIGKILL 2 of them mid-run (no drain, no goodbye) — the survivors must
 # detect the lapse within one lease TTL, re-form the mesh at dp=2 via the
