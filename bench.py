@@ -564,8 +564,11 @@ def measure_fleet(fluid, place=None):
     per = FLEET_REQUESTS // FLEET_CLIENTS
     codes, split = {}, {}
     lock = threading.Lock()
+    t0 = time.time()
 
     def client(cid):
+        from paddle_tpu.resilience import chaos
+
         rng = np.random.RandomState(cid)
         for _ in range(per):
             rows = int(rng.choice([1, 1, 1, 2, 4]))
@@ -577,12 +580,16 @@ def measure_fleet(fluid, place=None):
                 rep = hdrs.get("X-Fleet-Replica")
                 if rep:
                     split[rep] = split.get(rep, 0) + 1
-            # open-loop-ish pacing: submit on a clock, not on completion
-            time.sleep(FLEET_PACE_MS / 1000.0 * rng.rand() * 2)
+            # open-loop-ish pacing: submit on a clock, not on completion.
+            # An installed load_spike chaos fault compresses the clock by
+            # its scale while active — the deterministic traffic surge
+            # the autoscale drill rides.
+            mult = chaos.load_multiplier(time.time() - t0)
+            time.sleep(FLEET_PACE_MS / 1000.0 * rng.rand() * 2
+                       / max(1.0, mult))
 
     threads = [threading.Thread(target=client, args=(c,))
                for c in range(FLEET_CLIENTS)]
-    t0 = time.time()
     for t in threads:
         t.start()
     for t in threads:

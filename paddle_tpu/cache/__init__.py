@@ -28,11 +28,13 @@ import pickle
 from collections import OrderedDict
 
 from .. import flags
+from . import service
 from .keys import environment, program_digest, stable_digest
 from .store import L2Store
 
 __all__ = ["CompileCache", "L2Store", "default_store", "environment",
-           "program_digest", "serialize_support", "stable_digest"]
+           "program_digest", "serialize_support", "service",
+           "stable_digest"]
 
 flags.define(
     "compile_cache_dir", str, "",
@@ -101,6 +103,11 @@ class CompileCache:
         self.l2_fallbacks = 0
         self.l2_puts = 0
         self.l2_put_bytes = 0
+        # distributed compile service (FLAGS_compile_service): local-L2
+        # misses satisfied by fetching a peer's blob vs. escalated to a
+        # local compile (we won the single-flight lease, or no service)
+        self.l2_remote_hits = 0
+        self.l2_remote_misses = 0
 
     # -- L1 ------------------------------------------------------------
     def get(self, key):
@@ -174,6 +181,9 @@ class CompileCache:
                 "fallbacks": self.l2_fallbacks,
                 "puts": self.l2_puts,
                 "put_bytes": self.l2_put_bytes,
+                "remote_hits": self.l2_remote_hits,
+                "remote_misses": self.l2_remote_misses,
+                "service": flags.get("compile_service") or None,
             },
         }
 
@@ -203,10 +213,12 @@ class CompileCache:
             return None
         outcome, payload, _header = store.get(digest)
         if outcome == "miss":
-            self.l2_misses += 1
-            _l2_count("misses", self.kind)
-            return None
-        if outcome != "hit":
+            payload = self._remote_fetch(digest, store, mon)
+            if payload is None:
+                self.l2_misses += 1
+                _l2_count("misses", self.kind)
+                return None
+        elif outcome != "hit":
             self.count_l2_fallback(mon, reason=outcome)
             return None
         try:
@@ -218,6 +230,46 @@ class CompileCache:
         self.l2_hits += 1
         _l2_count("hits", self.kind)
         return compiled
+
+    def _remote_fetch(self, digest, store, mon=None):
+        """fetch_compiled: satisfy a local-L2 miss from the distributed
+        compile service. Returns the entry's payload bytes (committed to
+        the local store first, exactly as a local put would land) or
+        None — None means THIS process compiles, either because it won
+        the single-flight lease, the leaseholder died, or the service is
+        off/unreachable."""
+        if not service.enabled():
+            return None
+        blob = service.fetch_blob(digest, wait_s=0.0)
+        if blob is None:
+            if service.try_lease(digest):
+                # our lease: compile here; aot_sink publishes the blob
+                self.l2_remote_misses += 1
+                _l2_count("remote_misses", self.kind)
+                return None
+            # someone else is compiling this digest right now — park for
+            # their publish instead of burning a duplicate compile
+            blob = service.fetch_blob(digest, wait_s=service.WAIT_S)
+        if blob is None:
+            self.l2_remote_misses += 1
+            _l2_count("remote_misses", self.kind)
+            return None
+        # commit through put_blob (framing + digest + checksum checks),
+        # then re-read: the fetched entry must be exactly as trustworthy
+        # as a locally written one, or we fall back to compiling
+        max_mb = int(flags.get("compile_cache_dir_max_mb"))
+        if not store.put_blob(
+                digest, blob,
+                max_bytes=max_mb * (1 << 20) if max_mb > 0 else None):
+            self.count_l2_fallback(mon, reason="remote_corrupt")
+            return None
+        outcome, payload, _header = store.get(digest)
+        if outcome != "hit" or payload is None:
+            self.count_l2_fallback(mon, reason=f"remote_{outcome}")
+            return None
+        self.l2_remote_hits += 1
+        _l2_count("remote_hits", self.kind)
+        return payload
 
     def count_l2_fallback(self, mon=None, reason=None):
         self.l2_fallbacks += 1
@@ -255,6 +307,13 @@ class CompileCache:
             self.l2_put_bytes += nbytes
             _l2_count("puts", self.kind)
             _l2_count("put_bytes", self.kind, nbytes)
+            if service.enabled():
+                # publish to the compile service: releases our
+                # single-flight lease and wakes every peer parked on
+                # this digest (faults swallowed inside offer_blob)
+                blob = store.read_blob(digest)
+                if blob is not None:
+                    service.offer_blob(digest, blob)
 
         return sink
 

@@ -27,7 +27,18 @@ tests/test_compile_cache.py.
 
 import hashlib
 
-__all__ = ["program_digest", "stable_digest", "environment"]
+__all__ = ["program_digest", "stable_digest", "environment", "is_digest"]
+
+_HEX = set("0123456789abcdef")
+
+
+def is_digest(value):
+    """True for a well-formed sha256 hex key. The compile service
+    validates digests at its RPC boundary with this — a digest is also a
+    filename under FLAGS_compile_cache_dir, so an unvalidated one from a
+    peer would be a path-traversal vector."""
+    return (isinstance(value, str) and len(value) == 64
+            and set(value) <= _HEX)
 
 # program content digests, keyed (id(program), mutation) — sha256 of a big
 # JSON string is the expensive part, and it is only ever needed on the

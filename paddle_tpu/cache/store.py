@@ -25,6 +25,7 @@ and recompile over. The only exceptions that escape are programming
 errors, not cache-content errors.
 """
 
+import itertools
 import json
 import os
 import struct
@@ -36,6 +37,11 @@ __all__ = ["L2Store", "MAGIC"]
 
 MAGIC = b"PTAC1\n"
 _SUFFIX = ".aot"
+
+# tmp names carry pid AND a process-local sequence: two THREADS putting
+# the same digest concurrently must not share a tmp file, or one commits
+# the other's half-written bytes (itertools.count is atomic in CPython)
+_tmp_seq = itertools.count()
 
 
 def _sha256(data):
@@ -118,9 +124,22 @@ class L2Store:
         }
         hb = json.dumps(header, sort_keys=True).encode("utf-8")
         blob = MAGIC + struct.pack(">Q", len(hb)) + hb + payload
+        self._commit(digest, blob)
+        if max_bytes and max_bytes > 0:
+            self.prune(max_bytes)
+        return len(blob)
+
+    def _commit(self, digest, blob):
+        """Atomic write: tmp in the same directory, fsync, os.replace.
+        Concurrent same-digest writers last-write-win — each writes its
+        own tmp, and the replace is atomic, so a reader sees exactly one
+        writer's whole file, never an interleaving. A commit over an
+        existing entry is counted (two replicas that both missed both
+        compiled: wasted work the compile service exists to dedup)."""
         os.makedirs(self.root, exist_ok=True)
         path = self.path_for(digest)
-        tmp = f"{path}.tmp.{os.getpid()}"
+        duplicate = os.path.exists(path)
+        tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_seq)}"
         try:
             with open(tmp, "wb") as f:
                 f.write(blob)
@@ -133,9 +152,45 @@ class L2Store:
                     os.unlink(tmp)
                 except OSError:
                     pass
+        if duplicate:
+            from .. import monitor
+
+            if monitor.enabled():
+                monitor.registry().counter(
+                    "compile_cache_l2_duplicate_puts_total",
+                    help="same-digest L2 entries overwritten by a "
+                         "concurrent or repeated put (last writer "
+                         "wins, atomically)").inc()
+
+    # -- peer exchange (fetch_compiled wire payload) --------------------
+    def read_blob(self, digest):
+        """Raw on-disk bytes of one entry — the WHOLE file (magic +
+        header + payload), which is exactly the fetch_compiled wire
+        payload — or None when absent/unreadable."""
+        try:
+            with open(self.path_for(digest), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def put_blob(self, digest, blob, max_bytes=None):
+        """Commit a whole-file blob fetched from a peer, re-validating
+        magic, framing, digest binding, and the payload checksum BEFORE
+        the commit — a corrupt or mislabeled publish must not poison
+        this cache. Environment (jax/jaxlib/backend) is NOT checked
+        here: get() refuses stale entries on read, same as local ones.
+        Returns True on commit."""
+        blob = bytes(blob)
+        header, payload = self._parse(blob)
+        if header is None or payload is None:
+            return False
+        if header.get("digest") != digest \
+                or _sha256(payload) != header.get("payload_sha256"):
+            return False
+        self._commit(digest, blob)
         if max_bytes and max_bytes > 0:
             self.prune(max_bytes)
-        return len(blob)
+        return True
 
     # -- maintenance ---------------------------------------------------
     def entries(self):

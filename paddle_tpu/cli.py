@@ -1096,7 +1096,12 @@ def _cmd_fleet_replica(args):
         # fleet spin-up: every replica shares one persistent compile
         # cache, so only the first one ever compiles each bucket
         flags.set("compile_cache_dir", args.cache_dir)
-    if args.chaos_kill_at is not None or args.chaos_hang_at is not None:
+    if args.compile_service:
+        # ... or each replica has its own cache and the first MISSER
+        # compiles while the rest fetch the blob by digest
+        flags.set("compile_service", args.compile_service)
+    if args.chaos_kill_at is not None or args.chaos_hang_at is not None \
+            or args.chaos_delay_ms is not None:
         from .resilience import chaos
 
         monkey = chaos.ChaosMonkey()
@@ -1106,6 +1111,12 @@ def _cmd_fleet_replica(args):
             monkey.add(chaos.Fault("replica_hang", at=args.chaos_hang_at,
                                    times=args.chaos_hang_times,
                                    delay_ms=args.chaos_hang_ms))
+        if args.chaos_delay_ms is not None:
+            # every dispatch: a deterministic per-batch service-time
+            # floor -> replica capacity ~= 1000/delay_ms batches/s on
+            # any host, which makes autoscale drills reproducible
+            monkey.add(chaos.Fault("delay", at=0, times=1 << 62,
+                                   delay_ms=args.chaos_delay_ms))
         chaos.install(monkey)
     place = CPUPlace() if args.place == "cpu" else TPUPlace(0)
     config = ServeConfig(
@@ -1229,10 +1240,39 @@ def _cmd_fleet_router(args):
         from . import obs as obs_mod
 
         obs_client = obs_mod.maybe_start("router", endpoint=args.obs)
+    autoscaler = None
+    if args.autoscale_model_dir:
+        import tempfile
+
+        from .serve.fleet import (Autoscaler, AutoscalerConfig,
+                                  ProcessReplicaSpawner)
+
+        workdir = tempfile.mkdtemp(prefix="fleet_autoscale_")
+        argv_base = [sys.executable, "-m", "paddle_tpu", "fleet",
+                     "replica", "--model-dir", args.autoscale_model_dir,
+                     "--place", "cpu", "--port", "0"]
+        if args.compile_service:
+            argv_base += ["--compile-service", args.compile_service]
+        if args.autoscale_cache_dir:
+            argv_base += ["--cache-dir", args.autoscale_cache_dir]
+        spawner = ProcessReplicaSpawner(
+            argv_base, workdir,
+            per_replica_cache=not args.autoscale_cache_dir)
+        autoscaler = Autoscaler(router, spawner, AutoscalerConfig(
+            target_p99_ms=args.autoscale_target_p99_ms,
+            high_queue_rows=args.autoscale_queue_rows,
+            min_replicas=args.autoscale_min,
+            max_replicas=args.autoscale_max,
+            interval_s=args.autoscale_interval,
+            cooldown_out_s=args.autoscale_cooldown_out,
+            cooldown_in_s=args.autoscale_cooldown_in)).start()
     print(f"fleet router on {args.host}:{args.port} over "
           f"{sorted(replicas.values()) or 'master-discovered replicas'}",
           file=sys.stderr)
     serve_fleet(router, host=args.host, port=args.port)
+    if autoscaler is not None:
+        autoscaler.stop()
+        autoscaler.spawner.stop_all()
     if obs_client is not None:
         obs_client.stop()
     return 0
@@ -1843,6 +1883,11 @@ def main(argv=None):
                     metavar="K",
                     help="hang on K consecutive dispatches from "
                          "--chaos-hang-at (straggler drills)")
+    fr.add_argument("--chaos-delay-ms", type=float, default=None,
+                    help="sleep this long on EVERY executor dispatch: a "
+                         "deterministic service-time floor, so capacity "
+                         "drills (the green_gate autoscale drill) see "
+                         "the same queueing on any host")
     fr.add_argument("--obs", default=None, metavar="HOST:PORT",
                     help="push metrics/journal/trace snapshots to this "
                          "obs collector (see `paddle_tpu obs collect`)")
@@ -1850,6 +1895,13 @@ def main(argv=None):
                     help="persistent compile-cache directory shared by "
                          "the fleet (FLAGS_compile_cache_dir): only the "
                          "first replica compiles, the rest deserialize")
+    fr.add_argument("--compile-service", default=None, metavar="HOST:PORT",
+                    help="distributed compile service (a parallel.master "
+                         "with compiled_* ops, FLAGS_compile_service): on "
+                         "an L2 miss, fetch the serialized executable by "
+                         "digest instead of compiling — scale-out warm "
+                         "start with compile_cache_misses == 0. Needs "
+                         "--cache-dir")
     fo = fsub.add_parser("router", help="run the fleet router over a "
                                         "replica set")
     fo.add_argument("--replicas", default="",
@@ -1869,6 +1921,34 @@ def main(argv=None):
                     help="hedge a silent first attempt after this long")
     fo.add_argument("--obs", default=None, metavar="HOST:PORT",
                     help="push router metrics to this obs collector")
+    fo.add_argument("--autoscale-model-dir", default=None, metavar="DIR",
+                    help="enable the autoscaler: spawn `fleet replica` "
+                         "processes serving this save_inference_model "
+                         "dir when the latency target breaches, drain "
+                         "them away when load calms")
+    fo.add_argument("--autoscale-min", type=int, default=1,
+                    help="autoscaler floor (replicas)")
+    fo.add_argument("--autoscale-max", type=int, default=4,
+                    help="autoscaler ceiling (replicas)")
+    fo.add_argument("--autoscale-target-p99-ms", type=float, default=500.0,
+                    help="windowed router p99 the autoscaler holds")
+    fo.add_argument("--autoscale-queue-rows", type=float, default=None,
+                    help="queued rows across the fleet that also arm "
+                         "scale-out")
+    fo.add_argument("--autoscale-interval", type=float, default=1.0,
+                    help="control-loop tick seconds")
+    fo.add_argument("--autoscale-cooldown-out", type=float, default=5.0,
+                    help="seconds between scale-outs")
+    fo.add_argument("--autoscale-cooldown-in", type=float, default=30.0,
+                    help="seconds between scale-ins")
+    fo.add_argument("--autoscale-cache-dir", default=None,
+                    help="shared --cache-dir for spawned replicas "
+                         "(default: per-replica dirs under a temp "
+                         "workdir — with --compile-service, warm start "
+                         "then rides fetch_compiled, not the filesystem)")
+    fo.add_argument("--compile-service", default=None, metavar="HOST:PORT",
+                    help="pass through to spawned replicas so scale-out "
+                         "warm-starts from peers' compiles")
 
     ob = sub.add_parser("obs", help="fleet-wide observability: collector "
                                     "sink, live top table, merged "

@@ -39,9 +39,9 @@ import time
 
 from . import rpc as _rpc
 
-__all__ = ["Task", "MasterService", "MasterClient", "Heartbeater",
-           "task_iterator", "PassAfter", "PassBefore", "NoMoreAvailable",
-           "AllTasksFailed"]
+__all__ = ["Task", "MembershipTable", "MasterService", "MasterClient",
+           "Heartbeater", "task_iterator", "PassAfter", "PassBefore",
+           "NoMoreAvailable", "AllTasksFailed"]
 
 
 class PassBefore(RuntimeError):
@@ -89,6 +89,104 @@ def _partition(chunks, chunks_per_task):
     return tasks
 
 
+class MembershipTable:
+    """THE TTL'd, epoch-fenced membership primitive.
+
+    One implementation serves both control planes: the elastic trainer
+    mesh (MasterService wraps it in RPC ops) and the serving fleet
+    (serve/fleet/membership.py holds one directly). The contract:
+
+    - every join/leave/TTL-lapse bumps a monotonically increasing
+      *membership epoch* — a lapse IS a leave, not a soft mark;
+    - heartbeats are generation-fenced: a beat from a lapsed (already
+      reaped) member is refused (``known=False``) — the member must
+      re-JOIN, which lands it in a strictly NEWER epoch, so a zombie can
+      never resurrect the epoch the survivors already moved away from;
+    - leaves are owner-guarded: a stale connection's teardown cannot
+      evict a member that already re-joined under a different owner.
+
+    Not synchronized — the embedding service holds its own lock around
+    every call (MasterService its condition variable, fleet Membership
+    its mutex). ``on_change`` fires under that lock on every epoch bump
+    so the embedder can invalidate forming barriers / update gauges.
+    """
+
+    def __init__(self, clock=time.monotonic, on_change=None):
+        self._clock = clock
+        self.on_change = on_change
+        self.members = {}  # name -> {"addr", "expire", "ttl", "owner"}
+        self.epoch = 0
+
+    def _bump(self):
+        self.epoch += 1
+        if self.on_change is not None:
+            self.on_change()
+
+    def reap(self, now=None):
+        """TTL lapse IS a leave: reaping bumps the epoch so survivors
+        resize. Returns the reaped names."""
+        now = self._clock() if now is None else now
+        dead = [n for n, m in self.members.items() if m["expire"] <= now]
+        for n in dead:
+            del self.members[n]
+        if dead:
+            self._bump()
+        return dead
+
+    def join(self, name, addr="", ttl=10.0, owner=None):
+        """(Re-)join under a fresh lease; always lands in a new epoch."""
+        self.reap()
+        self.members[name] = {"addr": str(addr),
+                              "expire": self._clock() + float(ttl),
+                              "ttl": float(ttl), "owner": owner}
+        self._bump()
+        return self.epoch
+
+    def leave(self, name, owner=None):
+        """Explicit departure. With `owner` set, only evicts a membership
+        the same owner created (stale-socket teardown guard). Returns
+        whether anything was evicted."""
+        m = self.members.get(name)
+        if m is not None and (owner is None or m["owner"] is None
+                              or m["owner"] == owner):
+            del self.members[name]
+            self._bump()
+            return True
+        return False
+
+    def heartbeat(self, name, epoch):
+        """Generation-fenced liveness. known=False means the member
+        lapsed (or never joined): refreshing its TTL here would resurrect
+        a stale epoch — it must re-join instead. ``stale`` tells a live
+        member its view of the epoch is behind (a resize is pending)."""
+        self.reap()
+        m = self.members.get(name)
+        if m is None:
+            return {"known": False, "epoch": self.epoch}
+        m["expire"] = self._clock() + m["ttl"]
+        return {"known": True, "epoch": self.epoch,
+                "stale": int(epoch) != self.epoch}
+
+    def refresh(self, name):
+        """Renew one member's lease without the epoch fence (used where
+        presence was already established under the embedder's lock)."""
+        m = self.members.get(name)
+        if m is not None:
+            m["expire"] = self._clock() + m["ttl"]
+
+    def get(self, name):
+        return self.members.get(name)
+
+    def addrs(self):
+        return {n: m["addr"] for n, m in self.members.items()}
+
+    def __contains__(self, name):
+        return name in self.members
+
+    def __len__(self):
+        return len(self.members)
+
+
 class MasterService:
     """In-process task-lease service; serve() exposes it over TCP."""
 
@@ -110,14 +208,22 @@ class MasterService:
         self.failed = []
         self.cur_pass = 0
         self._registry = {}  # (kind, name) -> (addr, expire_time)
-        # elastic membership: name -> {"addr", "expire", "ttl", "owner"}
-        # (owner = the serving connection that joined it, so a stale
+        # elastic membership: the shared TTL'd epoch-fenced table (owner =
+        # the serving connection that joined a member, so a stale
         # connection's teardown can't evict a member that already
-        # re-joined over a fresh socket)
-        self._members = {}
-        self._membership_epoch = 0
+        # re-joined over a fresh socket). Epoch bumps invalidate any
+        # barrier forming against an older epoch, under self._mu.
+        self._table = MembershipTable(on_change=self._membership_moved)
         self._barrier_arrived = {}  # (epoch, phase) -> set(names)
         self._barrier_release = {}  # (epoch, phase) -> sorted member list
+        # distributed compile service: first-misser compiles, peers fetch
+        # the serialized PTAC1 blob by content digest (single-flight
+        # leases dedup N simultaneous missers down to ONE compile)
+        self._compiled = {}        # digest -> whole-file PTAC1 blob
+        self._compile_leases = {}  # digest -> lease expire time
+        self._compile_counts = {"puts": 0, "duplicate_puts": 0, "gets": 0,
+                                "hits": 0, "waits": 0, "leases": 0,
+                                "lease_rejects": 0, "expired_leases": 0}
         self._stop = False
         self._init_done = False
         self._conns = set()  # accepted sockets, closed on stop()
@@ -258,7 +364,17 @@ class MasterService:
                     del self._registry[k]
                 # elastic membership TTL expiry (heartbeat lapse -> the
                 # survivors get a new epoch and resize)
-                self._reap_members_locked(now)
+                self._table.reap(now)
+                # single-flight compile leases whose holder died: wake
+                # blocked fetchers so one of them re-takes the lease and
+                # compiles instead of waiting on a corpse
+                lapsed = [d for d, exp in self._compile_leases.items()
+                          if exp <= now]
+                for d in lapsed:
+                    del self._compile_leases[d]
+                if lapsed:
+                    self._compile_counts["expired_leases"] += len(lapsed)
+                    self._mu.notify_all()
 
     def counts(self):
         with self._mu:
@@ -280,51 +396,30 @@ class MasterService:
                     in self._registry.items() if k == kind and exp > now}
 
     # ----------------------------------------------------------- membership
-    def _bump_epoch_locked(self):
-        """Every membership change advances the epoch and invalidates any
-        barrier forming against an older one (its waiters restart)."""
-        self._membership_epoch += 1
-        for key in [k for k in self._barrier_arrived
-                    if k[0] != self._membership_epoch]:
+    def _membership_moved(self):
+        """MembershipTable on_change hook (fires under self._mu): every
+        epoch bump invalidates any barrier forming against an older epoch
+        (its waiters restart) and wakes everyone parked on the lock."""
+        epoch = self._table.epoch
+        for key in [k for k in self._barrier_arrived if k[0] != epoch]:
             del self._barrier_arrived[key]
-        for key in [k for k in self._barrier_release
-                    if k[0] < self._membership_epoch - 1]:
+        for key in [k for k in self._barrier_release if k[0] < epoch - 1]:
             del self._barrier_release[key]
         self._mu.notify_all()
 
-    def _reap_members_locked(self, now):
-        """TTL lapse IS a leave: reaping bumps the epoch so survivors
-        resize. A reaped member's later heartbeat is refused (it must
-        re-join under a NEW epoch — never resurrect the old one)."""
-        dead = [n for n, m in self._members.items() if m["expire"] <= now]
-        for n in dead:
-            del self._members[n]
-        if dead:
-            self._bump_epoch_locked()
-        return dead
-
     def elastic_join(self, name, addr="", ttl=10.0, _owner=None):
         with self._mu:
-            self._reap_members_locked(time.monotonic())
-            self._members[name] = {"addr": str(addr),
-                                   "expire": time.monotonic() + float(ttl),
-                                   "ttl": float(ttl), "owner": _owner}
-            self._bump_epoch_locked()
-            return {"epoch": self._membership_epoch,
-                    "members": {n: m["addr"]
-                                for n, m in self._members.items()}}
+            self._table.join(name, addr, ttl, owner=_owner)
+            return {"epoch": self._table.epoch,
+                    "members": self._table.addrs()}
 
     def elastic_leave(self, name, _owner=None):
         """Explicit departure (SIGTERM-drain). With _owner set, only
         evicts a membership this connection created — a dead socket's
         teardown must not take down the re-joined incarnation."""
         with self._mu:
-            m = self._members.get(name)
-            if m is not None and (_owner is None or m["owner"] is None
-                                  or m["owner"] == _owner):
-                del self._members[name]
-                self._bump_epoch_locked()
-            return {"epoch": self._membership_epoch}
+            self._table.leave(name, owner=_owner)
+            return {"epoch": self._table.epoch}
 
     def elastic_heartbeat(self, name, epoch):
         """Generation-fenced liveness. known=False means the member lapsed
@@ -332,21 +427,13 @@ class MasterService:
         away from it, so refreshing the TTL here would resurrect a stale
         epoch — the worker must re-join instead."""
         with self._mu:
-            now = time.monotonic()
-            self._reap_members_locked(now)
-            m = self._members.get(name)
-            if m is None:
-                return {"known": False, "epoch": self._membership_epoch}
-            m["expire"] = now + m["ttl"]
-            return {"known": True, "epoch": self._membership_epoch,
-                    "stale": int(epoch) != self._membership_epoch}
+            return self._table.heartbeat(name, epoch)
 
     def elastic_membership(self):
         with self._mu:
-            self._reap_members_locked(time.monotonic())
-            return {"epoch": self._membership_epoch,
-                    "members": {n: m["addr"]
-                                for n, m in self._members.items()}}
+            self._table.reap()
+            return {"epoch": self._table.epoch,
+                    "members": self._table.addrs()}
 
     def elastic_barrier(self, name, epoch, phase="resize", timeout=30.0):
         """Block until every member of `epoch` arrived at (epoch, phase).
@@ -365,21 +452,20 @@ class MasterService:
         with self._mu:
             while True:
                 now = time.monotonic()
-                self._reap_members_locked(now)
-                if self._membership_epoch != epoch:
+                self._table.reap(now)
+                if self._table.epoch != epoch:
                     return {"ok": False, "restart": True,
-                            "epoch": self._membership_epoch}
-                m = self._members.get(name)
-                if m is None:
+                            "epoch": self._table.epoch}
+                if name not in self._table:
                     return {"ok": False, "restart": True, "unknown": True,
-                            "epoch": self._membership_epoch}
-                m["expire"] = now + m["ttl"]
+                            "epoch": self._table.epoch}
+                self._table.refresh(name)
                 key = (epoch, phase)
                 self._barrier_arrived.setdefault(key, set()).add(name)
                 members = self._barrier_release.get(key)
-                if members is None \
-                        and self._barrier_arrived[key] >= set(self._members):
-                    members = sorted(self._members)
+                if members is None and self._barrier_arrived[key] \
+                        >= set(self._table.members):
+                    members = sorted(self._table.members)
                     self._barrier_release[key] = members
                     self._mu.notify_all()
                 if members is not None:
@@ -388,11 +474,88 @@ class MasterService:
                             "rank": members.index(name)}
                 if now >= deadline:
                     return {"ok": False, "timeout": True,
-                            "epoch": self._membership_epoch,
+                            "epoch": self._table.epoch,
                             "waiting_for": sorted(
-                                set(self._members)
+                                set(self._table.members)
                                 - self._barrier_arrived.get(key, set()))}
                 self._mu.wait(min(0.05, max(0.001, deadline - now)))
+
+    # ------------------------------------------------- distributed compile
+    # fetch_compiled service: the first replica to miss a digest takes a
+    # single-flight lease and compiles; everyone else blocks on
+    # compiled_get until the winner publishes the serialized PTAC1 blob.
+    # Blobs are opaque whole-file bytes here — the fetching replica's
+    # L2Store re-validates magic/digest/payload checksum before commit,
+    # so a corrupt publish can never poison a peer's cache.
+
+    @staticmethod
+    def _check_digest(digest):
+        from ..cache.keys import is_digest
+
+        if not is_digest(digest):
+            raise _rpc.RpcError(f"malformed compile digest {digest!r}")
+        return digest
+
+    def compiled_put(self, digest, blob):
+        """Publish a compiled blob under its content digest and release
+        the single-flight lease; wakes every fetcher parked on it."""
+        digest, blob = self._check_digest(digest), bytes(blob)
+        with self._mu:
+            dup = digest in self._compiled
+            self._compiled[digest] = blob
+            self._compile_leases.pop(digest, None)
+            self._compile_counts["puts"] += 1
+            if dup:
+                self._compile_counts["duplicate_puts"] += 1
+            self._mu.notify_all()
+            return {"stored": True, "bytes": len(blob), "duplicate": dup}
+
+    def compiled_get(self, digest, wait_s=0.0):
+        """Fetch a blob by digest; with wait_s > 0, park until the
+        leaseholder publishes it (or the wait times out -> None)."""
+        digest = self._check_digest(digest)
+        deadline = time.monotonic() + float(wait_s)
+        with self._mu:
+            self._compile_counts["gets"] += 1
+            waited = False
+            while True:
+                blob = self._compiled.get(digest)
+                if blob is not None:
+                    self._compile_counts["hits"] += 1
+                    if waited:
+                        self._compile_counts["waits"] += 1
+                    return blob
+                now = time.monotonic()
+                if now >= deadline:
+                    return None
+                waited = True
+                self._mu.wait(min(0.05, max(0.001, deadline - now)))
+
+    def compiled_lease(self, digest, ttl=120.0):
+        """Single-flight compile dedup: grant at most one live lease per
+        digest. granted=True means the caller compiles (and must
+        compiled_put, or the lease expires and a waiter re-leases);
+        granted=False means someone else is on it (or it's cached)."""
+        digest = self._check_digest(digest)
+        with self._mu:
+            if digest in self._compiled:
+                return {"granted": False, "cached": True}
+            now = time.monotonic()
+            exp = self._compile_leases.get(digest)
+            if exp is not None and exp > now:
+                self._compile_counts["lease_rejects"] += 1
+                return {"granted": False, "cached": False}
+            self._compile_leases[digest] = now + float(ttl)
+            self._compile_counts["leases"] += 1
+            return {"granted": True, "cached": False}
+
+    def compiled_stats(self):
+        with self._mu:
+            return dict(self._compile_counts,
+                        entries=len(self._compiled),
+                        bytes=sum(len(b)
+                                  for b in self._compiled.values()),
+                        active_leases=len(self._compile_leases))
 
     # -------------------------------------------------------------- serving
     def serve(self, bind="127.0.0.1:0"):
@@ -495,6 +658,14 @@ class MasterService:
                         reply = ("ok", self.elastic_membership())
                     elif op == "elastic_barrier":
                         reply = ("ok", self.elastic_barrier(*args))
+                    elif op == "compiled_put":
+                        reply = ("ok", self.compiled_put(*args))
+                    elif op == "compiled_get":
+                        reply = ("ok", self.compiled_get(*args))
+                    elif op == "compiled_lease":
+                        reply = ("ok", self.compiled_lease(*args))
+                    elif op == "compiled_stats":
+                        reply = ("ok", self.compiled_stats())
                     elif op == "counts":
                         reply = ("ok", self.counts())
                     elif op == "exit":
@@ -506,6 +677,10 @@ class MasterService:
                     key = next(k for k, cls in _ERRS.items()
                                if isinstance(e, cls))
                     reply = ("taskerr", key, str(e))
+                except _rpc.RpcError as e:
+                    # a bad argument (e.g. malformed compile digest)
+                    # rejects the op, not the connection
+                    reply = ("err", str(e))
                 _rpc._send_msg(conn, reply)
         except (ConnectionError, EOFError, OSError):
             return
@@ -641,6 +816,20 @@ class MasterClient:
 
     def elastic_barrier(self, name, epoch, phase="resize", timeout=30.0):
         return self._call("elastic_barrier", name, epoch, phase, timeout)
+
+    # distributed compile service (see cache/service.py for the client
+    # that rides these from the executors' L2-miss path)
+    def compiled_put(self, digest, blob):
+        return self._call("compiled_put", digest, blob)
+
+    def compiled_get(self, digest, wait_s=0.0):
+        return self._call("compiled_get", digest, wait_s)
+
+    def compiled_lease(self, digest, ttl=120.0):
+        return self._call("compiled_lease", digest, ttl)
+
+    def compiled_stats(self):
+        return self._call("compiled_stats")
 
     def counts(self):
         return self._call("counts")

@@ -17,6 +17,7 @@ raises UNAVAILABLE, twice" and prove the retry path end to end:
     grad_explode scale the health-recorded grad norms      (keyed on global step)
     worker_preempt  os.kill(self, SIGTERM)                 (keyed on global step)
     worker_join  spawn a trainer subprocess from `argv`    (keyed on global step)
+    load_spike   multiply open-loop offered QPS by `scale` (keyed on wall-clock seconds)
 
 delay/transient count *executor run calls* because that is what retry
 wraps (a retried step consumes several run-call indices — set `times` to
@@ -30,6 +31,14 @@ survivors resize within one step boundary instead of one TTL.
 worker_join spawns a fresh trainer subprocess (`argv`, tracked in
 monkey.spawned) at step N, so a grow-the-fleet drill is scriptable the
 same way a kill is.
+
+load_spike is the traffic fault: it is TIME-windowed, not index-keyed
+— `at` is seconds since the load generator started, and the fault is
+active for `duration_s` seconds. An open-loop driver (bench --fleet,
+the green_gate autoscale drill) multiplies its offered QPS by the
+product of every active spike's `scale` via `load_multiplier(elapsed)`,
+so a deterministic surge lands mid-run and the autoscaler has to absorb
+it.
 
 replica_kill/replica_hang are the serving-fleet faults: installed inside
 a replica process (`paddle_tpu fleet replica --chaos-kill-at N`), they
@@ -51,11 +60,11 @@ from .. import monitor
 from .errors import TransientError
 
 __all__ = ["Fault", "ChaosMonkey", "install", "uninstall", "active",
-           "on_run", "on_map_dispatch"]
+           "on_run", "on_map_dispatch", "load_multiplier"]
 
 _KINDS = ("delay", "transient", "nan", "sigterm", "replica_kill",
           "replica_hang", "worker_kill", "loss_spike", "grad_explode",
-          "worker_preempt", "worker_join")
+          "worker_preempt", "worker_join", "load_spike")
 
 # a "hung" replica is dead-but-connected: default far past any sane
 # request deadline so the router's probes, not patience, end the wait
@@ -64,7 +73,7 @@ _HANG_DEFAULT_MS = 3_600_000.0
 
 class Fault:
     def __init__(self, kind, at, times=1, delay_ms=None, label=None,
-                 scale=1e3, argv=None):
+                 scale=None, argv=None, duration_s=None):
         if kind not in _KINDS:
             raise ValueError(f"kind must be one of {_KINDS}, got {kind!r}")
         if delay_ms is None:
@@ -73,13 +82,21 @@ class Fault:
         if kind == "worker_join" and not argv:
             raise ValueError("worker_join needs argv (the trainer "
                              "subprocess command line)")
+        if scale is None:
+            # loss_spike/grad_explode want a detector-tripping multiplier;
+            # a 1000x traffic surge would just be a DoS drill
+            scale = 2.0 if kind == "load_spike" else 1e3
+        if duration_s is None and kind == "load_spike":
+            duration_s = 5.0
         self.kind = kind
-        self.at = int(at)        # run-call index or global step (see kind)
+        self.at = int(at)        # run-call index, global step, or seconds
         self.times = int(times)  # consecutive occurrences from `at`
         self.delay_ms = float(delay_ms)
         self.label = label       # None = any executor; else exact match
-        self.scale = float(scale)  # loss_spike/grad_explode multiplier
+        self.scale = float(scale)  # loss/grad/offered-QPS multiplier
         self.argv = list(argv) if argv else None  # worker_join command
+        self.duration_s = (float(duration_s)
+                           if duration_s is not None else None)
         self.fired = 0
 
     def _covers(self, n):
@@ -165,6 +182,22 @@ class ChaosMonkey:
                 self._fire(f, step, "elastic")
                 self.spawned.append(subprocess.Popen(f.argv))
 
+    def load_multiplier(self, elapsed_s):
+        """Open-loop offered-QPS multiplier `elapsed_s` seconds into the
+        run: the product of the scales of every load_spike active in its
+        [at, at + duration_s) window. Time-windowed, unlike every other
+        fault — the surge has a width, not an occurrence count; the
+        injection log and counter tick once per fault."""
+        mult = 1.0
+        for f in self.faults:
+            if f.kind != "load_spike":
+                continue
+            if f.at <= elapsed_s < f.at + f.duration_s:
+                if not f.fired:
+                    self._fire(f, round(float(elapsed_s), 3), "load")
+                mult *= f.scale
+        return mult
+
     def poison(self, step, metrics):
         """Runner hook: NaN-poison the fetched metrics for step `step`."""
         for f in self.faults:
@@ -244,3 +277,10 @@ def on_map_dispatch(n, pid):
     m = _active[0]
     if m is not None:
         m.on_map_dispatch(n, pid)
+
+
+def load_multiplier(elapsed_s):
+    """Module-level load_spike hook for open-loop drivers: 1.0 when no
+    monkey is installed or no spike covers `elapsed_s`."""
+    m = _active[0]
+    return m.load_multiplier(elapsed_s) if m is not None else 1.0

@@ -813,6 +813,26 @@ class Server:
         return sum(exe.compile_cache_info()["entries"]
                    for exe, _ in self._replicas)
 
+    def _cache_aggregate(self):
+        """Summed compile-cache counters across this server's executors.
+        fresh compiles = L1 misses not satisfied by the L2 (an L2 hit —
+        local file or fetched from the compile service — deserialized
+        instead of compiling). The autoscale drill asserts a scale-out
+        replica shows compile_cache_misses == 0 and remote hits > 0."""
+        agg = {"l1_misses": 0, "l2_hits": 0, "l2_remote_hits": 0,
+               "l2_remote_misses": 0, "l2_puts": 0, "l2_fallbacks": 0}
+        for exe, _ in self._replicas:
+            info = exe.compile_cache_info()
+            l2 = info.get("l2") or {}
+            agg["l1_misses"] += info.get("misses", 0)
+            agg["l2_hits"] += l2.get("hits", 0)
+            agg["l2_remote_hits"] += l2.get("remote_hits", 0)
+            agg["l2_remote_misses"] += l2.get("remote_misses", 0)
+            agg["l2_puts"] += l2.get("puts", 0)
+            agg["l2_fallbacks"] += l2.get("fallbacks", 0)
+        agg["misses"] = max(0, agg["l1_misses"] - agg["l2_hits"])
+        return agg
+
     def latency_percentiles(self, *ps):
         """{p: ms} over requests served by THIS server (the registry's
         serve_request_ms series is shared process-wide)."""
@@ -827,6 +847,7 @@ class Server:
         pct = self.latency_percentiles(50, 95, 99)
         rows = self._own["rows"].value
         padded = self._own["padded_rows"].value
+        cache = self._cache_aggregate()
         return {
             "ready": self.ready(),
             "state": self.state(),
@@ -846,4 +867,6 @@ class Server:
             "compile_entries": self._cache_entries(),
             "steady_state_compiles":
                 self._cache_entries() - self._warm_entries,
+            "compile_cache_misses": cache["misses"],
+            "compile_cache": cache,
         }

@@ -12,21 +12,30 @@ One Router load-balances POST /v1/infer over N serve.http replicas:
         router.drain("r1")             # lame-duck + wait for exit
 
 Membership tracks healthy / degraded / dead / lame_duck per replica
-(active /healthz + /stats probes, heartbeat TTLs, per-replica circuit
-breakers); routing picks least-queue-depth and owns failures — 503s and
+(active /healthz + /stats probes, per-replica circuit breakers), with
+liveness riding the elastic master's TTL'd epoch-fenced MembershipTable
+— ONE membership primitive serves elastic training and the fleet;
+routing picks least-queue-depth and owns failures — 503s and
 transient transport faults retry on another replica under a per-request
 deadline and a fleet-wide retry budget, with optional hedging. Killing
 one of N replicas mid-load loses zero accepted requests; draining one
 finishes its backlog and exits clean (rolling restarts drop nothing).
 
+An Autoscaler holds a latency target by spawning/draining replica
+processes (hysteresis, cooldowns, min/max bounds; scale-in reuses
+Router.drain so nothing accepted is lost), and FLAGS_compile_service
+makes scale-out warm: new replicas fetch serialized executables by
+digest instead of compiling (compile_cache_misses == 0 on joiners).
+
 `python -m paddle_tpu fleet replica|router ...` runs either half as a
 process; `make_fleet_http` is the router's own HTTP frontend.
 """
 
+from .autoscaler import Autoscaler, AutoscalerConfig, ProcessReplicaSpawner
 from .health import HealthProber, http_fetch
 from .membership import (DEAD, DEGRADED, HEALTHY, LAME_DUCK, STATE_VALUES,
                          CircuitBreaker, Membership, Replica)
-from .policy import LeastQueueDepthPolicy
+from .policy import LeastQueueDepthPolicy, scale_in_victim
 from .router import (FleetConfig, Router, http_transport, make_fleet_http,
                      serve_fleet)
 
@@ -34,7 +43,8 @@ __all__ = [
     "HEALTHY", "DEGRADED", "DEAD", "LAME_DUCK", "STATE_VALUES",
     "CircuitBreaker", "Replica", "Membership",
     "HealthProber", "http_fetch",
-    "LeastQueueDepthPolicy",
+    "LeastQueueDepthPolicy", "scale_in_victim",
+    "Autoscaler", "AutoscalerConfig", "ProcessReplicaSpawner",
     "FleetConfig", "Router", "http_transport", "make_fleet_http",
     "serve_fleet",
 ]

@@ -155,12 +155,12 @@ class HealthProber:
             # a drain is router-initiated; a passing probe does not
             # un-drain a replica
             return
-        if stats and self._degraded(stats):
+        if stats and self._degraded(rep, stats):
             want = DEGRADED
         if rep.state != want:
             ms.set_state(rep, want)
 
-    def _degraded(self, stats):
+    def _degraded(self, rep, stats):
         try:
             if self.degraded_queue_rows is not None and \
                     float(stats.get("queue_rows") or 0) \
@@ -171,8 +171,16 @@ class HealthProber:
                 if p99 is not None and float(p99) == float(p99) \
                         and float(p99) > self.degraded_p99_ms:
                     return True
-            if float(stats.get("steady_state_compiles") or 0) > 0:
-                return True
+            compiles = float(stats.get("steady_state_compiles") or 0)
         except (TypeError, ValueError):
             return False
-        return False
+        # "recompiling" means the count is RISING. steady_state_compiles
+        # is cumulative (it never decreases), so treating any nonzero
+        # value as degraded would pin a replica degraded forever after
+        # its first post-warmup compile; compare against the previous
+        # probe instead, and recover within one round of it going flat.
+        prev = rep.compiles_seen
+        rep.compiles_seen = compiles
+        if prev is None:
+            return compiles > 0
+        return compiles > prev

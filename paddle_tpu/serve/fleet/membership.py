@@ -1,5 +1,14 @@
 """Fleet membership: who the replicas are and whether to send them work.
 
+Liveness rides the elastic master's `MembershipTable`
+(parallel/master.py): ONE TTL'd, epoch-fenced membership primitive
+serves both control planes — elastic trainers and the serving fleet.
+The fleet carries no TTL arithmetic of its own: a heartbeat refreshes a
+table lease, a lapse IS a leave (the table bumps its epoch and the next
+beat must re-JOIN under a strictly newer one, so a zombie can never
+resurrect an epoch the fleet already moved past), and `expire()` merely
+translates reaped leases into replica state.
+
 The router owns this state; replicas only report. Each replica carries:
 
   state      healthy    probes pass, load nominal      -> routable
@@ -24,6 +33,7 @@ import threading
 import time
 
 from ... import monitor
+from ...parallel.master import MembershipTable
 
 __all__ = ["HEALTHY", "DEGRADED", "DEAD", "LAME_DUCK", "CircuitBreaker",
            "Replica", "Membership", "STATE_VALUES"]
@@ -125,6 +135,9 @@ class Replica:
         self.last_heartbeat = None
         self.last_probe = None
         self.last_error = None
+        # cumulative steady_state_compiles at the last probe: the prober
+        # degrades on a RISING count, recovers when it goes flat
+        self.compiles_seen = None
 
     @property
     def queue_rows(self):
@@ -147,6 +160,20 @@ class Membership:
         self._clock = clock if clock is not None else time.monotonic
         self._lock = threading.Lock()
         self._replicas = {}  # name -> Replica
+        # the SAME TTL'd, epoch-fenced table the elastic master serves
+        # trainers with; Replica objects keep the serving-side
+        # annotations (breaker, probe stats, routability state) the
+        # trainer plane has no use for — liveness lives in the table.
+        # All table calls run under self._lock (the table itself is
+        # unsynchronized by contract).
+        self.table = MembershipTable(clock=self._clock)
+
+    @property
+    def epoch(self):
+        """Monotonic membership epoch: bumps on every join, leave, and
+        TTL lapse (the elastic trainer plane's generation fence)."""
+        with self._lock:
+            return self.table.epoch
 
     def _make_breaker(self):
         return CircuitBreaker(failure_threshold=self.breaker_failures,
@@ -155,7 +182,9 @@ class Membership:
 
     def add(self, name, endpoint, via_heartbeat=False, state=DEAD):
         """Register (or re-endpoint) a replica; static adds start DEAD
-        and earn routability from the first successful probe."""
+        and earn routability from the first successful probe. Static
+        registrations hold a non-expiring table lease — only
+        heartbeat-registered replicas ride the TTL."""
         with self._lock:
             rep = self._replicas.get(name)
             if rep is None:
@@ -165,22 +194,38 @@ class Membership:
                 self._replicas[name] = rep
             else:
                 rep.endpoint = endpoint
+            if name not in self.table:
+                ttl = (self.heartbeat_ttl_s if via_heartbeat
+                       else float("inf"))
+                self.table.join(name, endpoint, ttl=ttl)
         self._update_gauges()
         return rep
 
     def heartbeat(self, name, endpoint):
-        """A replica said hello: refresh its TTL (registering it on the
-        first beat). A heartbeat proves the process is alive, not that it
-        serves — routability still comes from the prober."""
+        """A replica said hello: refresh its table lease (registering it
+        on the first beat). A heartbeat proves the process is alive, not
+        that it serves — routability still comes from the prober. A beat
+        from a replica whose lease already lapsed cannot refresh the old
+        lease: the table reaped it (epoch moved), so it re-JOINs under a
+        strictly newer epoch."""
         rep = self.add(name, endpoint, via_heartbeat=True)
         with self._lock:
             rep.via_heartbeat = True
             rep.last_heartbeat = self._clock()
+            m = self.table.get(name)
+            if m is None or m["ttl"] == float("inf"):
+                # lapsed, or promoted from a static registration: take a
+                # fresh TTL'd lease (a new epoch — never resurrect)
+                self.table.join(name, endpoint,
+                                ttl=self.heartbeat_ttl_s)
+            else:
+                self.table.heartbeat(name, self.table.epoch)
         return rep
 
     def remove(self, name):
         with self._lock:
             self._replicas.pop(name, None)
+            self.table.leave(name)
         self._update_gauges()
 
     def get(self, name):
@@ -207,15 +252,15 @@ class Membership:
         self._update_gauges()
 
     def expire(self):
-        """Heartbeat-registered replicas past their TTL go dead — the
-        no-goodbye death path (matches the master registry's lease)."""
-        now = self._clock()
+        """Replicas whose table lease lapsed go dead — the no-goodbye
+        death path. The TTL bookkeeping itself lives in the shared
+        MembershipTable: the reap bumps the membership epoch, and the
+        zombie's next beat re-joins under a newer one."""
         changed = False
         with self._lock:
-            for rep in self._replicas.values():
-                if rep.via_heartbeat and rep.state != DEAD \
-                        and rep.last_heartbeat is not None \
-                        and now - rep.last_heartbeat > self.heartbeat_ttl_s:
+            for name in self.table.reap():
+                rep = self._replicas.get(name)
+                if rep is not None and rep.state != DEAD:
                     rep.state = DEAD
                     rep.last_error = "heartbeat TTL expired"
                     changed = True

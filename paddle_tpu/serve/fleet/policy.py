@@ -13,7 +13,25 @@ import threading
 
 from .membership import HEALTHY
 
-__all__ = ["LeastQueueDepthPolicy"]
+__all__ = ["LeastQueueDepthPolicy", "scale_in_victim"]
+
+
+def scale_in_victim(candidates, prefer=()):
+    """Which routable replica the autoscaler should drain next.
+
+    Prefer the most recently autoscaled-up replica that is still
+    routable (LIFO: the baseline fleet outlives the surge capacity);
+    otherwise the shallowest queue loses — draining the replica with the
+    least backlog finishes fastest and strands the least work behind a
+    LAME_DUCK. Returns a name or None."""
+    names = {r.name: r for r in candidates}
+    for name in reversed(list(prefer)):
+        if name in names:
+            return name
+    if not names:
+        return None
+    return min(sorted(names.values(), key=lambda r: r.name),
+               key=lambda r: r.queue_rows).name
 
 
 class LeastQueueDepthPolicy:

@@ -450,10 +450,19 @@ class Router:
         ps = ps or (50, 95, 99)
         return self._own_request_ms.percentiles(*ps)
 
+    def latency_window(self):
+        """(bucket_edges, cumulative_counts) of the router-side request
+        latency histogram. The autoscaler diffs successive snapshots for
+        a WINDOWED p99 — the cumulative percentiles answer "since boot",
+        which is useless as a control signal once history piles up."""
+        snap = self._own_request_ms.snapshot()
+        return self._own_request_ms.buckets, snap["buckets"]
+
     def stats(self):
         pct = self.latency_percentiles(50, 95, 99)
         return {
             "replicas": self.membership.describe(),
+            "membership_epoch": self.membership.epoch,
             "healthy_replicas": self.membership.healthy_count(),
             "requests": self._own["requests"].value,
             "retries": self._own["retries"].value,

@@ -11,6 +11,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -384,6 +385,199 @@ def test_journal_summary_renders_l2_outcomes():
     assert "1 persistent warm starts" in text
     assert "2 evictions" in text
     assert "1 L2 fallbacks" in text
+
+
+# ---------------------------------------------------------------------------
+# concurrent same-digest puts: atomic, last-writer-wins, counted
+# ---------------------------------------------------------------------------
+
+def test_concurrent_same_digest_puts_atomic_and_counted(tmp_path):
+    """Regression (satellite): N writers committing the SAME digest must
+    last-write-win atomically — a concurrent get() sees exactly one
+    writer's whole entry, never a torn interleaving — and every overwrite
+    is counted on compile_cache_l2_duplicate_puts_total."""
+    import threading
+
+    store = L2Store(str(tmp_path))
+    digest = "f" * 64
+    payload = b"q" * 4096
+    with flags.flag_guard(monitor=True):
+        store.put(digest, payload)  # seed: every racer below overwrites
+        stop = threading.Event()
+        bad = []
+
+        def reader():
+            while not stop.is_set():
+                outcome, got, _header = store.get(digest)
+                # atomic replace: the entry is always whole and valid
+                if outcome != "hit" or got != payload:
+                    bad.append(outcome)
+                    return
+
+        def writer():
+            for _ in range(5):
+                store.put(digest, payload)
+
+        r = threading.Thread(target=reader)
+        ws = [threading.Thread(target=writer) for _ in range(4)]
+        r.start()
+        for w in ws:
+            w.start()
+        for w in ws:
+            w.join(30)
+        stop.set()
+        r.join(30)
+        snap = monitor.registry().snapshot()
+    assert bad == [], bad
+    assert store.get(digest)[0] == "hit"
+    # no tmp debris leaked from the 20 concurrent commits
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+    dups = sum(v for k, v in snap.items()
+               if "compile_cache_l2_duplicate_puts_total" in k)
+    assert dups == 20, snap
+
+
+def test_put_blob_validates_framing_digest_binding_and_checksum(tmp_path):
+    """put_blob is the fetch_compiled commit path: it must re-validate a
+    peer's blob (magic, framing, digest binding, payload checksum) before
+    the atomic replace, so a corrupt or mislabeled publish can never
+    poison the local cache."""
+    src = L2Store(str(tmp_path / "src"))
+    dst = L2Store(str(tmp_path / "dst"))
+    digest = "a" * 64
+    src.put(digest, b"payload" * 100)
+    blob = src.read_blob(digest)
+    assert blob is not None and blob.startswith(b"PTAC1\n")
+    # a clean publish commits and reads back as a hit
+    assert dst.put_blob(digest, blob) is True
+    outcome, payload, _header = dst.get(digest)
+    assert outcome == "hit" and payload == b"payload" * 100
+    # mislabeled: blob's header digest != the digest it was offered under
+    assert dst.put_blob("b" * 64, blob) is False
+    assert dst.get("b" * 64)[0] == "miss"
+    # payload corruption: checksum mismatch refuses the commit
+    torn = blob[:-4] + bytes(b ^ 0xFF for b in blob[-4:])
+    assert dst.put_blob(digest, torn) is False
+    # foreign garbage: framing refuses it
+    assert dst.put_blob(digest, b"not a cache entry") is False
+    # the earlier good entry survived every refused commit
+    assert dst.get(digest)[0] == "hit"
+
+
+# ---------------------------------------------------------------------------
+# distributed compile service (fetch_compiled RPC on the elastic master)
+# ---------------------------------------------------------------------------
+
+def test_compile_service_single_flight_lease_and_parked_fetch():
+    import threading
+
+    from paddle_tpu.parallel.master import MasterService
+
+    svc = MasterService()
+    digest = "c" * 64
+    try:
+        grants = []
+
+        def racer():
+            grants.append(svc.compiled_lease(digest)["granted"])
+
+        ts = [threading.Thread(target=racer) for _ in range(5)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        assert sum(grants) == 1, grants  # single-flight: ONE compiler
+        got = {}
+
+        def parked():
+            got["blob"] = svc.compiled_get(digest, wait_s=30.0)
+
+        t = threading.Thread(target=parked)
+        t.start()
+        time.sleep(0.1)
+        assert t.is_alive()  # parked on the leaseholder's publish
+        svc.compiled_put(digest, b"ptac-blob")
+        t.join(10)
+        assert got["blob"] == b"ptac-blob"
+        stats = svc.compiled_stats()
+        assert stats["leases"] == 1 and stats["lease_rejects"] == 4
+        assert stats["waits"] >= 1 and stats["active_leases"] == 0
+        # a lease on a cached digest: fetch it, don't compile it
+        assert svc.compiled_lease(digest) == {"granted": False,
+                                              "cached": True}
+        # a repeat publish is a duplicate (last writer wins)
+        assert svc.compiled_put(digest, b"ptac-blob2")["duplicate"]
+        assert svc.compiled_stats()["duplicate_puts"] == 1
+    finally:
+        svc.stop()
+
+
+def test_compile_service_rejects_malformed_digest_not_connection():
+    """A path-traversal-shaped digest rejects the OP, not the TCP
+    connection: the same client keeps working after the refusal."""
+    from paddle_tpu.parallel.master import MasterClient, MasterService
+    from paddle_tpu.parallel.rpc import RpcError
+
+    svc = MasterService()
+    port = svc.serve()
+    c = MasterClient(f"127.0.0.1:{port}")
+    try:
+        with pytest.raises(RpcError):
+            c.compiled_get("../../etc/passwd")
+        with pytest.raises(RpcError):
+            c.compiled_lease("A" * 64)  # uppercase hex: refused
+        assert c.compiled_stats()["entries"] == 0  # connection survived
+    finally:
+        c.close()
+        svc.stop()
+
+
+def test_remote_fetch_commits_to_local_l2_and_counts(tmp_path):
+    """The executor-side client path end to end over TCP: a peer's
+    published blob lands in the local L2 (remote hit), an unpublished
+    digest wins the lease (remote miss -> compile here), and a
+    mislabeled publish falls back instead of poisoning the cache."""
+    from paddle_tpu.cache import service
+    from paddle_tpu.parallel.master import MasterService
+
+    svc = MasterService()
+    port = svc.serve()
+    payload = b"p" * 256
+    digest = "c" * 64
+    src = L2Store(str(tmp_path / "src"))
+    src.put(digest, payload)
+    blob = src.read_blob(digest)
+    dst = L2Store(str(tmp_path / "dst"))
+    cc = CompileCache("executor")
+    try:
+        with flags.flag_guard(compile_service=f"127.0.0.1:{port}",
+                              compile_cache_dir=str(tmp_path / "dst"),
+                              monitor=True):
+            assert service.enabled()
+            # the compiler's aot_sink side: publish the whole-file blob
+            assert service.offer_blob(digest, blob) is True
+            # the fetching replica's side: L2 miss -> remote hit
+            assert cc._remote_fetch(digest, dst) == payload
+            assert dst.get(digest)[0] == "hit"  # committed locally
+            assert cc.l2_remote_hits == 1
+            # nobody compiled this digest: we win the lease -> None
+            assert cc._remote_fetch("d" * 64, dst) is None
+            assert cc.l2_remote_misses == 1
+            # a mislabeled publish: put_blob refuses, fallback counted
+            svc.compiled_put("e" * 64, blob)
+            assert cc._remote_fetch("e" * 64, dst) is None
+            assert cc.l2_fallbacks == 1
+            assert dst.get("e" * 64)[0] == "miss"  # never committed
+            info = cc.info()["l2"]
+            assert info["remote_hits"] == 1
+            assert info["remote_misses"] == 1
+            assert info["service"] == f"127.0.0.1:{port}"
+            snap = monitor.registry().snapshot()
+            assert sum(v for k, v in snap.items()
+                       if "compile_cache_l2_remote_hits_total" in k) == 1
+    finally:
+        service.reset()
+        svc.stop()
 
 
 @needs_serialize

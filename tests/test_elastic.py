@@ -421,6 +421,38 @@ def test_lapsed_member_heartbeat_refused_and_rejoin_never_resurrects():
     svc.stop()
 
 
+def test_fleet_and_elastic_share_one_membership_primitive():
+    """Satellite: the serving fleet's Membership and the elastic master
+    embed the SAME MembershipTable class, and both embedded instances
+    honor the same lapse-refuse-rejoin contract — there is exactly one
+    place TTL arithmetic lives."""
+    from paddle_tpu.parallel.master import MembershipTable
+    from paddle_tpu.serve.fleet import Membership
+
+    svc = _svc()
+    fleet = Membership()
+    assert type(svc._table) is MembershipTable
+    assert type(fleet.table) is MembershipTable
+
+    def contract(table, lock):
+        with lock:
+            e = table.join("shared", ttl=0.05)
+        time.sleep(0.15)
+        with lock:
+            hb = table.heartbeat("shared", e)  # lapsed: reaps, refuses
+            assert hb["known"] is False
+            assert "shared" not in table
+            lapse = table.epoch
+            assert lapse > e
+            e2 = table.join("shared", ttl=30.0)
+            assert e2 > lapse  # rejoin under a strictly newer epoch
+            table.leave("shared")
+
+    contract(svc._table, svc._mu)      # the elastic trainer plane
+    contract(fleet.table, fleet._lock)  # the serving fleet plane
+    svc.stop()
+
+
 def test_resize_barrier_restarts_on_concurrent_leave_and_join():
     """Satellite: a barrier forming against epoch E must restart (not
     deadlock, not release a stale set) when a join AND a leave land while

@@ -118,6 +118,46 @@ def test_membership_candidates_exclude_lame_duck_and_dead():
     assert sorted(r.name for r in ms.candidates(exclude={"a"})) == ["b"]
 
 
+def test_membership_rides_shared_table_lapse_refuse_rejoin():
+    """Satellite: fleet liveness IS the elastic master's MembershipTable
+    — same class, same epoch-fenced lapse/refuse/rejoin contract, and
+    the fleet keeps no TTL arithmetic of its own (the table's lease is
+    the only thing expire() consults)."""
+    from paddle_tpu.parallel.master import MembershipTable
+
+    now = [0.0]
+    ms = Membership(heartbeat_ttl_s=5.0, clock=lambda: now[0])
+    assert type(ms.table) is MembershipTable  # the trainer plane's class
+    rep = ms.heartbeat("r0", "h:1")
+    ms.set_state(rep, HEALTHY)
+    e = ms.epoch
+    now[0] = 6.0
+    ms.expire()  # the lease lapsed: a lapse IS a leave
+    assert rep.state == DEAD and "r0" not in ms.table
+    assert ms.epoch > e  # ... so the epoch bumped
+    lapse_epoch = ms.epoch
+    # the zombie's raw table beat is refused — known=False, never a
+    # resurrection of the lapsed lease
+    assert ms.table.heartbeat("r0", e)["known"] is False
+    assert "r0" not in ms.table
+    # the fleet-level beat re-JOINs under a strictly newer epoch
+    ms.heartbeat("r0", "h:1")
+    assert ms.epoch > lapse_epoch
+    assert ms.table.get("r0")["ttl"] == 5.0
+    # no parallel bookkeeping: expiring the TABLE lease alone is what
+    # kills the replica (there is nothing else to keep it alive)
+    ms.set_state(rep, HEALTHY)
+    ms.table.members["r0"]["expire"] = now[0] - 1.0
+    ms.expire()
+    assert rep.state == DEAD
+    assert rep.last_error == "heartbeat TTL expired"
+    # static registrations hold a non-expiring lease: never reaped
+    ms.add("static", "h:2")
+    now[0] = 1e9
+    ms.expire()
+    assert "static" in ms.table
+
+
 # ---------------------------------------------------------------------------
 # policy
 # ---------------------------------------------------------------------------
@@ -224,6 +264,28 @@ def test_prober_degraded_thresholds_and_recovery():
     stats["steady_state_compiles"] = 1    # zero-compile contract broken
     pr.tick()
     assert ms.get("r0").state == DEGRADED
+
+
+def test_prober_recovers_within_one_round_when_compiles_go_flat():
+    """Satellite regression: "degraded (recompiling)" must be a DELTA
+    judgement. The old prober pinned a replica DEGRADED forever once the
+    cumulative steady_state_compiles count went positive; recovery must
+    land within ONE probe round of the count going flat."""
+    stats = {"queue_rows": 0, "p99_ms": 1.0, "steady_state_compiles": 0}
+    ms, pr = _prober({"ep:1": lambda: ("ok", dict(stats))},
+                     degraded_queue_rows=100, degraded_p99_ms=50.0)
+    pr.tick()
+    assert ms.get("r0").state == HEALTHY
+    stats["steady_state_compiles"] = 3  # post-warmup compiles observed
+    pr.tick()
+    assert ms.get("r0").state == DEGRADED
+    pr.tick()  # count flat: recompiling is OVER — healthy again
+    assert ms.get("r0").state == HEALTHY
+    stats["steady_state_compiles"] = 4  # rising again -> degraded again
+    pr.tick()
+    assert ms.get("r0").state == DEGRADED
+    pr.tick()
+    assert ms.get("r0").state == HEALTHY
 
 
 def test_prober_passing_probe_does_not_undrain_lame_duck():

@@ -535,6 +535,34 @@ def test_chaos_replica_hang_sleeps_dead_but_connected():
     assert f.delay_ms >= 600_000.0
 
 
+def test_chaos_load_spike_window_product_and_module_hook():
+    """Satellite: load_spike is TIME-windowed (active [at, at+duration)),
+    overlapping spikes multiply, each fault fires its injection record
+    once, and the module-level hook reads 1.0 with nothing installed —
+    so bench/green_gate loadgen loops can divide their pacing by it
+    unconditionally."""
+    assert chaos.load_multiplier(99.0) == 1.0  # nothing installed
+    monkey = chaos.ChaosMonkey([
+        chaos.Fault("load_spike", at=5.0, scale=4.0, duration_s=10.0),
+        chaos.Fault("load_spike", at=12.0, scale=2.0, duration_s=10.0),
+    ])
+    chaos.install(monkey)
+    try:
+        assert chaos.load_multiplier(0.0) == 1.0   # before the window
+        assert chaos.load_multiplier(5.0) == 4.0   # inclusive start
+        assert chaos.load_multiplier(13.0) == 8.0  # overlap: product
+        assert chaos.load_multiplier(15.0) == 2.0  # first spike ended
+        assert chaos.load_multiplier(22.0) == 1.0  # exclusive end
+    finally:
+        chaos.uninstall()
+    assert chaos.load_multiplier(13.0) == 1.0  # uninstalled again
+    kinds = [kind for kind, _key, _label in monkey.injected]
+    assert kinds == ["load_spike", "load_spike"]  # fired once each
+    # defaults: a bare load_spike doubles traffic for 5 s
+    f = chaos.Fault("load_spike", at=0)
+    assert f.scale == 2.0 and f.duration_s == 5.0
+
+
 # -- end-to-end: trainer + chaos + restore ------------------------------
 
 

@@ -909,6 +909,200 @@ if [ $? -ne 0 ]; then
     exit 1
 fi
 
+# autoscale drill: 2 real replica processes (each with its OWN empty L2,
+# warm-started through the distributed compile service) behind the router;
+# a load_spike chaos fault multiplies the open-loop QPS x5 — the
+# autoscaler must scale 2->4 real processes, every joiner must report
+# compile_cache_misses == 0 with fetch hits > 0, no accepted request may
+# be lost, and after the spike the calm rounds must drain the surge
+# capacity back to 2 via Router.drain with both processes exiting 0.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel.master import MasterService
+from paddle_tpu.resilience import chaos
+from paddle_tpu.serve.fleet import (Autoscaler, AutoscalerConfig,
+                                    FleetConfig, ProcessReplicaSpawner,
+                                    Router)
+from paddle_tpu.serve.fleet.autoscaler import _window_p99
+
+tmp = tempfile.mkdtemp(prefix="fleet_autoscale_gate_")
+prog, startup = fluid.Program(), fluid.Program()
+with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=3)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(startup)
+model_dir = os.path.join(tmp, "model")
+with fluid.program_guard(prog, startup):
+    fluid.io.save_inference_model(model_dir, ["x"], [y], exe)
+
+# the distributed compile service: an in-process elastic master
+svc = MasterService()
+mport = svc.serve()
+
+# every replica gets its OWN empty L2 (per_replica_cache): warm start can
+# only come through fetch_compiled. --chaos-delay-ms pins per-dispatch
+# service time, so capacity ~= 1000/40 = 25 req/s per replica on any host.
+argv_base = [sys.executable, "-m", "paddle_tpu", "fleet", "replica",
+             "--model-dir", model_dir, "--place", "cpu", "--port", "0",
+             "--max-batch", "1", "--max-queue-rows", "10000",
+             "--chaos-delay-ms", "40",
+             "--compile-service", f"127.0.0.1:{mport}"]
+spawner = ProcessReplicaSpawner(
+    argv_base, tmp, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    per_replica_cache=True)
+
+router = None
+auto = None
+stop = threading.Event()
+try:
+    # baseline: 2 replicas, brought up SEQUENTIALLY so the warm-start
+    # contract is deterministic — as0 compiles and publishes, as1 must
+    # fetch everything (its own L2 starts empty)
+    t0 = time.time()
+    (n0, ep0), = spawner.spawn_many(1)
+    t_first = time.time() - t0
+    t0 = time.time()
+    (n1, ep1), = spawner.spawn_many(1)
+    t_second = time.time() - t0
+    print(f"startup: first (compiles) {t_first:.1f}s, "
+          f"second (fetches) {t_second:.1f}s", flush=True)
+
+    def rep_stats(ep):
+        with urllib.request.urlopen(f"http://{ep}/stats", timeout=10) as r:
+            return json.loads(r.read())
+
+    s1 = rep_stats(ep1)
+    assert s1["compile_cache_misses"] == 0, s1["compile_cache"]
+    assert s1["compile_cache"]["l2_remote_hits"] >= 1, s1["compile_cache"]
+    print("baseline warm start: ok", s1["compile_cache"], flush=True)
+
+    router = Router({n0: ep0, n1: ep1},
+                    config=FleetConfig(probe_interval_s=0.2,
+                                       request_deadline_ms=60000))
+    deadline = time.time() + 60
+    while router.membership.healthy_count() < 2 and time.time() < deadline:
+        router.prober.tick()
+        time.sleep(0.2)
+    assert router.membership.healthy_count() == 2
+    router.prober.start()
+
+    auto = Autoscaler(router, spawner, AutoscalerConfig(
+        target_p99_ms=250.0, high_queue_rows=8, min_replicas=2,
+        max_replicas=4, scale_step=2, breach_rounds=2, calm_rounds=12,
+        hysteresis=0.5, cooldown_out_s=5.0, cooldown_in_s=4.0,
+        interval_s=0.5, drain_timeout_s=60.0)).start()
+
+    # open-loop load: ~12 QPS baseline; the load_spike multiplies it x5
+    # for 12 s starting at t=6 s — 60 QPS >> 2 replicas' ~50 req/s
+    spike_at, spike_len, spike_scale = 6.0, 12.0, 5.0
+    chaos.install(chaos.ChaosMonkey([
+        chaos.Fault("load_spike", at=spike_at, duration_s=spike_len,
+                    scale=spike_scale)]))
+    body = json.dumps({"inputs": {"x": [[1.0, 2.0, 3.0, 4.0]]}}).encode()
+    codes, lock = {}, threading.Lock()
+    pending = []
+
+    def fire():
+        status, _h, _b = router.route(body)
+        with lock:
+            codes[status] = codes.get(status, 0) + 1
+
+    t_start = time.time()
+
+    def loadgen():
+        while not stop.is_set():
+            mult = chaos.load_multiplier(time.time() - t_start)
+            time.sleep(1.0 / (12.0 * mult))
+            th = threading.Thread(target=fire)
+            th.start()
+            pending.append(th)
+
+    lg = threading.Thread(target=loadgen)
+    lg.start()
+
+    # the surge must push the autoscaler to max (2 -> 4 real processes)
+    deadline = t_start + spike_at + spike_len + 30
+    while time.time() < deadline:
+        if len(router.membership.candidates()) >= 4:
+            break
+        time.sleep(0.25)
+    routable = [r.name for r in router.membership.candidates()]
+    t_scaled = time.time() - t_start
+    assert len(routable) == 4, (routable, auto.describe())
+    assert auto.scale_outs == 2, auto.describe()
+    print(f"scale-out 2->4 at t={t_scaled:.1f}s "
+          f"(spike began at {spike_at}s)", flush=True)
+
+    # every scale-out replica warm-started through fetch_compiled
+    for name in routable:
+        if name in (n0, n1):
+            continue
+        st = rep_stats(spawner.endpoints[name])
+        assert st["compile_cache_misses"] == 0, (name, st["compile_cache"])
+        assert st["compile_cache"]["l2_remote_hits"] >= 1, \
+            (name, st["compile_cache"])
+    print("scale-out warm start: ok", flush=True)
+
+    # after the spike: calm rounds drain the surge capacity back to min,
+    # through Router.drain (lame-duck, finish backlog) then SIGTERM
+    deadline = t_start + spike_at + spike_len + 120
+    while time.time() < deadline:
+        if len(router.membership.candidates()) == 2 and auto.scale_ins >= 2:
+            break
+        time.sleep(0.5)
+    assert len(router.membership.candidates()) == 2, auto.describe()
+    assert auto.scale_ins == 2, auto.describe()
+    assert [r["exit_code"] for r in auto.drain_reports] == [0, 0], \
+        auto.drain_reports
+    assert all(r["drained"] for r in auto.drain_reports), \
+        auto.drain_reports
+    t_calm = time.time() - t_start
+    print(f"scale-in 4->2 at t={t_calm:.1f}s, drains clean", flush=True)
+
+    # recovery: the post-drain window's p99 is back near service time
+    edges, w0 = router.latency_window()
+    time.sleep(5.0)
+    _edges, w1 = router.latency_window()
+    stop.set()
+    lg.join(10)
+    for th in pending:
+        th.join(70)
+    p99 = _window_p99(edges, w0, w1)
+    assert p99 is not None and p99 < 1500.0, p99
+    # THE contract: the surge and both drains lost nothing
+    assert set(codes) == {200}, f"lost requests: {codes}"
+    total = sum(codes.values())
+    assert total > 300, codes
+    stats = svc.compiled_stats()
+    print(f"autoscale drill: ok ({total} requests, 0 lost, "
+          f"p99 {p99:.0f} ms after scale-in, compile service "
+          f"{stats['puts']} puts / {stats['hits']} hits)", flush=True)
+finally:
+    stop.set()
+    if auto is not None:
+        auto.stop()
+    chaos.uninstall()
+    if router is not None:
+        router.stop()
+    spawner.stop_all()
+    svc.stop()
+    shutil.rmtree(tmp, ignore_errors=True)
+EOF
+if [ $? -ne 0 ]; then
+    echo "GATE: AUTOSCALE DRILL RED — do not commit" >&2
+    exit 1
+fi
+
 # obs fleet drill: 3 real replica processes push metrics/journals/trace
 # dumps into one collector (--obs) while a chaos replica_hang makes r2 the
 # straggler — the aggregated /metrics must show all three replicas with
