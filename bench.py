@@ -509,6 +509,104 @@ def measure_serve(fluid, place=None, requests=None, max_batch=None,
     }
 
 
+def measure_dry_continuous(fluid):
+    """bench.py --dry continuous block: iteration-level scheduling vs
+    run-to-completion under mixed long/short decode load.
+
+    The A/B the subsystem exists for: N long autoregressive streams
+    saturate the batch while short requests trickle in. The continuous
+    scheduler admits a short into a free slot at the very next model
+    step; a run-to-completion (one-shot FIFO) server makes it wait out
+    every long stream queued ahead. Reports the short-request p99 for
+    solo (empty server), continuous-under-load, and the FIFO
+    comparator, plus the ratio green_gate gates on and the
+    zero-steady-state-compile check."""
+    import threading
+
+    from paddle_tpu import monitor, serve
+    from paddle_tpu.serve.continuous import (ContinuousConfig,
+                                             ContinuousServer)
+
+    monitor.reset()
+    feat = 16
+    long_steps, short_steps = 48, 2
+    n_long, n_short = 3, 16
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[feat], dtype="float32")
+        y = fluid.layers.fc(input=x, size=feat, act="tanh")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    rs = np.random.RandomState(0)
+    long_rows = rs.rand(n_long, feat).astype(np.float32)
+    short_rows = rs.rand(n_short, feat).astype(np.float32)
+
+    def p99(ms):
+        return float(np.percentile(np.asarray(ms), 99))
+
+    srv = ContinuousServer(place=fluid.CPUPlace(),
+                           config=ContinuousConfig(max_slots=8))
+    srv.add_model("bench", prog, ["x"], [y], state={"x": y.name},
+                  scope=scope, slo_ms=100.0)
+    srv.start()
+    try:
+        # solo baseline: shorts against an idle server
+        solo_ms = []
+        for row in short_rows:
+            t0 = time.perf_counter()
+            srv.infer({"x": row}, steps=short_steps, timeout=60)
+            solo_ms.append((time.perf_counter() - t0) * 1000.0)
+        # mixed load: the longs saturate, shorts join the running batch
+        long_futs = [srv.submit({"x": r}, steps=long_steps)
+                     for r in long_rows]
+        cont_ms = []
+        for row in short_rows:
+            t0 = time.perf_counter()
+            srv.infer({"x": row}, steps=short_steps, timeout=60)
+            cont_ms.append((time.perf_counter() - t0) * 1000.0)
+        for f in long_futs:
+            f.result(timeout=120)
+        stats = srv.stats()
+    finally:
+        srv.stop()
+
+    # run-to-completion comparator: the same arrival order (longs queued
+    # first, then the shorts) served FIFO, each request decoded to
+    # completion before the next starts — head-of-line blocking by
+    # construction. Same executor, same compiled step.
+    def fifo_decode(row, steps):
+        cur = row.reshape(1, feat)
+        with fluid.scope_guard(scope):
+            for _ in range(steps):
+                cur = exe.run(prog, feed={"x": cur}, fetch_list=[y])[0]
+
+    t_base = time.perf_counter()
+    oneshot_ms = []
+    for row in long_rows:
+        fifo_decode(row, long_steps)
+    for row in short_rows:
+        fifo_decode(row, short_steps)
+        oneshot_ms.append((time.perf_counter() - t_base) * 1000.0)
+
+    short_p99_cont = p99(cont_ms)
+    short_p99_oneshot = p99(oneshot_ms)
+    return {
+        "long_streams": n_long, "long_steps": long_steps,
+        "short_requests": n_short, "short_steps": short_steps,
+        "slots": stats["models"]["bench"]["slots"],
+        "short_p99_solo_ms": round(p99(solo_ms), 3),
+        "short_p99_continuous_ms": round(short_p99_cont, 3),
+        "short_p99_oneshot_ms": round(short_p99_oneshot, 3),
+        "continuous_over_oneshot_ratio": round(
+            short_p99_cont / short_p99_oneshot, 4)
+        if short_p99_oneshot else None,
+        "model_steps": stats["models"]["bench"]["steps"],
+        "steady_state_compiles": stats["steady_state_compiles"],
+    }
+
+
 # fleet sizing (bench.py --fleet): N in-process replicas behind their
 # real HTTP frontends, one Router, mixed open-loop load.
 FLEET_REPLICAS = int(os.environ.get("BENCH_FLEET_REPLICAS", 3))
@@ -1675,6 +1773,13 @@ def measure_dry(fluid):
     result["serve"] = measure_serve(
         fluid, place=fluid.CPUPlace(), requests=128, max_batch=8,
         clients=8)
+    # continuous batching A/B: short-request p99 with iteration-level
+    # scheduling under long-decode load vs the run-to-completion FIFO
+    # comparator; after measure_serve (both reset the monitor)
+    try:
+        result["continuous"] = measure_dry_continuous(fluid)
+    except Exception as e:
+        result["continuous_error"] = f"{type(e).__name__}: {e}"
     _attach_compare(result)
     print(json.dumps(result))
 
@@ -1693,7 +1798,9 @@ def _key_direction(key):
     if leaf == "value" or any(
             t in leaf for t in ("per_sec", "qps", "img_s", "mfu")):
         return "higher"
-    if leaf.endswith("_ms") or "overhead" in leaf or "latency" in leaf:
+    if leaf.endswith("_ms") or leaf.endswith("_ratio") \
+            or "overhead" in leaf or "latency" in leaf \
+            or "compiles" in leaf:
         return "lower"
     return None
 

@@ -1,0 +1,18 @@
+"""Iteration-level (continuous) batching + multi-model serving.
+
+scheduler.py  ContinuousServer: the step loop — admission into free
+              slots, one warmed model step over the active slots,
+              eviction on completion; weighted least-lag across N
+              hosted models.
+slots.py      SlotBank: device-resident per-request decode state at a
+              fixed-capacity slot ladder.
+interop.py    Opara-style inter-op parallelism: dispatch independent
+              dataflow branches of an inference program concurrently.
+"""
+
+from .interop import InterOpRunner, independent_branches
+from .scheduler import ContinuousConfig, ContinuousServer
+from .slots import SlotBank
+
+__all__ = ["ContinuousConfig", "ContinuousServer", "SlotBank",
+           "InterOpRunner", "independent_branches"]

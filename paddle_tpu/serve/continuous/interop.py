@@ -1,0 +1,172 @@
+"""Opara-style inter-op parallelism for inference programs.
+
+Opara (PAPERS.md: arXiv 2312.10351) observes that an inference graph
+usually contains branches with no data dependence on each other —
+parallel heads, mixture experts, multi-task towers — and that running
+them as one sequential program leaves the overlap on the table. The
+PR-13 dataflow graph already exposes exactly this structure: two fetch
+targets whose backward closures over the SSA def-use edges are disjoint
+can be dispatched as independent sub-steps.
+
+`independent_branches` partitions a program's fetch targets into such
+groups. `InterOpRunner` dispatches one executor call per group without
+fencing between them — jax dispatch is asynchronous, so the branches'
+device work overlaps; the caller fences once when it reads the results
+back. Each per-branch executable is a separate compile-cache entry
+(XLA dead-code-eliminates the other branches), so the runner warms
+every (branch, shape) pair up front and the zero-steady-state-compile
+contract holds unchanged.
+
+Measured overlap is reported through the existing overlap-efficiency
+gauge (`fleet_overlap_efficiency`, obs/timeline.overlap_efficiency):
+the critical branch plays the "compute" role, the off-critical-path
+branch time is the "comm" to hide under it.
+"""
+
+import time
+
+from ... import monitor
+from ...analysis.dataflow import build_graph
+
+__all__ = ["independent_branches", "InterOpRunner"]
+
+
+def _closure(graph, start):
+    """All node indices reachable backward from `start` over preds
+    (every edge kind — any ordering constraint couples the branches)."""
+    seen = set()
+    stack = [start]
+    while stack:
+        i = stack.pop()
+        if i in seen:
+            continue
+        seen.add(i)
+        stack.extend(p for p in graph.preds[i] if p not in seen)
+    return seen
+
+
+def _def_node(graph, name):
+    """Index of the node producing the final version of `name`, or None
+    when no op writes it (a passthrough feed)."""
+    best = None
+    for node in graph.nodes:
+        if name in node.writes:
+            best = node.idx
+    return best
+
+
+def independent_branches(program, feed_names, fetch_names):
+    """Partition fetch targets into dataflow-independent groups.
+
+    Returns a list of lists of POSITIONS into `fetch_names`, in first-
+    appearance order. Fetches whose backward closures share any op are
+    grouped together; a single group means the program has no inter-op
+    parallelism to exploit.
+    """
+    graph = build_graph(program, feed_names=feed_names)
+    closures = []
+    for name in fetch_names:
+        d = _def_node(graph, str(name))
+        closures.append(_closure(graph, d) if d is not None else set())
+    groups = []  # [(node_set, [positions])]
+    for pos, cl in enumerate(closures):
+        merged = None
+        for g in groups:
+            if g[0] & cl:
+                if merged is None:
+                    g[0].update(cl)
+                    g[1].append(pos)
+                    merged = g
+                else:  # this fetch bridges two groups: fold them
+                    merged[0].update(g[0])
+                    merged[1].extend(g[1])
+                    g[0].clear()
+                    g[1].clear()
+        if merged is None:
+            groups.append([set(cl), [pos]])
+    return [sorted(g[1]) for g in groups if g[1]]
+
+
+class InterOpRunner:
+    """Dispatch a program's independent fetch branches concurrently.
+
+    Drop-in for the single `exe.run(...)` a serving step makes: run()
+    returns device arrays aligned with `fetch_vars`, but issues one
+    donated sub-step per branch back to back, overlapping their device
+    work. `gauge_label` names the fleet_overlap_efficiency series this
+    runner reports under.
+    """
+
+    def __init__(self, exe, program, scope, fetch_vars, groups,
+                 gauge_label="interop"):
+        self.exe = exe
+        self.program = program
+        self.scope = scope
+        self.fetch_vars = list(fetch_vars)
+        self.groups = [list(g) for g in groups]
+        self.gauge_label = gauge_label
+        # per-branch solo cost (ms), measured during warm(); the serial
+        # estimate sum(costs) vs the measured overlapped wall time is
+        # what the efficiency gauge joins
+        self.branch_cost_ms = [None] * len(self.groups)
+        self.last_efficiency = None
+
+    @property
+    def parallel(self):
+        return len(self.groups) > 1
+
+    def run(self, feed):
+        """Device arrays in fetch_vars order; branches dispatched
+        without an intermediate fence."""
+        from ...executor import as_numpy
+
+        outs = [None] * len(self.fetch_vars)
+        t0 = time.perf_counter()
+        parts = []
+        for g in self.groups:
+            res = self.exe.run(self.program, feed=feed,
+                               fetch_list=[self.fetch_vars[i] for i in g],
+                               scope=self.scope, return_numpy=False)
+            parts.append((g, res))
+        for g, res in parts:
+            for i, o in zip(g, res):
+                outs[i] = o
+        if self.parallel and all(c is not None for c in self.branch_cost_ms):
+            for o in outs:  # fence: the overlap window ends here
+                as_numpy(o)
+            self._report((time.perf_counter() - t0) * 1000.0)
+        return outs
+
+    def _report(self, measured_ms):
+        from ...obs.timeline import overlap_efficiency
+
+        critical = max(self.branch_cost_ms)
+        hidden = sum(self.branch_cost_ms) - critical
+        eff = overlap_efficiency(critical, hidden, measured_ms)
+        if eff is None:
+            return
+        self.last_efficiency = eff
+        monitor.registry().gauge(
+            "fleet_overlap_efficiency",
+            help="fraction of off-critical-path work hidden under the "
+                 "critical path",
+            replica=self.gauge_label).set(eff)
+
+    def warm(self, feed):
+        """Compile every branch at this feed shape and (re)measure the
+        per-branch solo cost the efficiency gauge needs. Two passes:
+        the first eats the compile, the second times the executable."""
+        from ...executor import as_numpy
+
+        for bi, g in enumerate(self.groups):
+            fetches = [self.fetch_vars[i] for i in g]
+            for o in self.exe.run(self.program, feed=feed,
+                                  fetch_list=fetches, scope=self.scope,
+                                  return_numpy=False):
+                as_numpy(o)
+            t0 = time.perf_counter()
+            for o in self.exe.run(self.program, feed=feed,
+                                  fetch_list=fetches, scope=self.scope,
+                                  return_numpy=False):
+                as_numpy(o)
+            self.branch_cost_ms[bi] = (time.perf_counter() - t0) * 1000.0

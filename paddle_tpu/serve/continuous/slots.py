@@ -1,0 +1,167 @@
+"""Decode state slots: the persistent per-request memory of a
+continuous batcher.
+
+A one-shot batcher owns a request for exactly one dispatch; an
+iteration-level scheduler owns it for K model steps, and between steps
+the request's decode state (the recurrent feeds the next step consumes,
+the token prefix produced so far, the per-slot step counter and RNG
+seed) has to live SOMEWHERE the next step can reach without a host
+round-trip per value. `SlotBank` is that somewhere: one device-resident
+array per feed var, shaped [capacity + 1, *example_shape], where row i
+is slot i's current value and the extra row is a scratch lane that
+padding reads from and writes to.
+
+The bank is addressed by a fixed-capacity slot ladder: a step over k
+active slots gathers the smallest ladder rung >= k lanes (pad lanes
+point at the scratch row), so every gather/step/scatter shape the
+scheduler can ever issue is known at start() and AOT-warmable — the
+slot-count analog of serve/buckets.py's row-count ladder, preserving
+the zero-steady-state-compile contract. Gather and scatter move rows
+verbatim (no arithmetic), so a value fed back through the bank is
+bitwise the value the model fetched — the foundation of the decode
+parity guarantee.
+"""
+
+import numpy as np
+
+from ..buckets import ladder
+
+__all__ = ["SlotBank"]
+
+
+class SlotBank:
+    """Fixed-capacity per-slot state arrays plus slot bookkeeping.
+
+    var_specs maps feed name -> (example_shape, dtype). Every feed var
+    of the model lives in the bank — recurrent state vars get scattered
+    back each step, static per-request feeds (conditioning inputs) are
+    written once at admission and only ever gathered.
+    """
+
+    def __init__(self, capacity, var_specs, slot_buckets=None):
+        import jax
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.scratch = self.capacity  # the pad lane's row index
+        self.rungs = ladder(self.capacity, slot_buckets)
+        self.names = list(var_specs)
+        self._specs = {n: (tuple(int(d) for d in shape), str(dtype))
+                       for n, (shape, dtype) in var_specs.items()}
+        self._state = {}
+        for n, (shape, dtype) in self._specs.items():
+            self._state[n] = jax.device_put(
+                jnp.zeros((self.capacity + 1,) + shape, dtype=dtype))
+        self._free = list(range(self.capacity - 1, -1, -1))  # pop() -> 0
+        self._active = []  # sorted slot ids in use
+        self.steps = np.zeros(self.capacity, dtype=np.int64)
+        self.seeds = np.zeros(self.capacity, dtype=np.uint32)
+        self.requests = [None] * self.capacity
+        # token prefix: per-slot list of per-step output row tuples,
+        # stacked into [steps, ...] arrays when the request completes
+        self._prefix = [None] * self.capacity
+
+    # -- slot bookkeeping ------------------------------------------------
+    @property
+    def free_slots(self):
+        return len(self._free)
+
+    def active_slots(self):
+        """Sorted tuple of in-use slot ids — the deterministic lane
+        order every step gathers and scatters in."""
+        return tuple(self._active)
+
+    def alloc(self, request, seed=0):
+        """Claim a slot for `request`; None when the bank is full."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._active.append(slot)
+        self._active.sort()
+        self.steps[slot] = 0
+        self.seeds[slot] = np.uint32(seed)
+        self.requests[slot] = request
+        self._prefix[slot] = []
+        return slot
+
+    def release(self, slot):
+        self._active.remove(slot)
+        self._free.append(slot)
+        self.requests[slot] = None
+        self._prefix[slot] = None
+        self.steps[slot] = 0
+
+    # -- device-resident state ------------------------------------------
+    def write_row(self, slot, rows):
+        """Stage one request's initial feed values into its slot (the
+        admission write; a single-lane scatter, warmed at start)."""
+        jnp = self._jnp
+        idx = np.asarray([slot], dtype=np.int32)
+        for n, v in rows.items():
+            shape, dtype = self._specs[n]
+            row = np.asarray(v, dtype=dtype).reshape((1,) + shape)
+            self._state[n] = self._state[n].at[idx].set(jnp.asarray(row))
+
+    def gather(self, idx):
+        """{name: [len(idx), ...]} device arrays for the given lane
+        indices (pad lanes pass self.scratch)."""
+        idx = self._jnp.asarray(np.asarray(idx, dtype=np.int32))
+        return {n: a[idx] for n, a in self._state.items()}
+
+    def scatter(self, idx, values):
+        """Write fetched next-state rows back into the bank. `values`
+        maps feed name -> [len(idx), ...]; pad lanes must index the
+        scratch row so their garbage lands nowhere observable."""
+        jnp = self._jnp
+        idx = jnp.asarray(np.asarray(idx, dtype=np.int32))
+        for n, v in values.items():
+            self._state[n] = self._state[n].at[idx].set(jnp.asarray(v))
+
+    def lane_index(self, bucket):
+        """[bucket] lane->slot index array: active slots first, scratch
+        for the pad lanes."""
+        idx = np.full(bucket, self.scratch, dtype=np.int32)
+        active = self._active
+        idx[:len(active)] = active
+        return idx
+
+    def rng_rows(self, idx):
+        """Deterministic per-(slot, step) RNG key rows, uint32 [n, 2]:
+        (seed, step). A request replayed solo sees the identical key
+        sequence, so stochastic decodes stay parity-comparable."""
+        idx = np.asarray(idx)
+        rows = np.zeros((len(idx), 2), dtype=np.uint32)
+        for lane, slot in enumerate(idx):
+            if slot < self.capacity:
+                rows[lane, 0] = self.seeds[slot]
+                rows[lane, 1] = np.uint32(self.steps[slot])
+        return rows
+
+    # -- token prefix ----------------------------------------------------
+    def append_outputs(self, slot, out_rows):
+        """Append this step's fetched output rows to the slot's prefix."""
+        self._prefix[slot].append(out_rows)
+
+    def take_prefix(self, slot):
+        """[steps, ...] stacked arrays, one per output fetch, in fetch
+        order — the completed request's result."""
+        steps = self._prefix[slot]
+        n_out = len(steps[0]) if steps else 0
+        return [np.stack([s[i] for s in steps], axis=0)
+                for i in range(n_out)]
+
+    # -- warmup ----------------------------------------------------------
+    def warm(self):
+        """Compile every gather/scatter shape the scheduler can issue:
+        one lane count per ladder rung, plus the single-lane admission
+        write. Run before serving so no step ever compiles."""
+        for b in self.rungs:
+            idx = np.full(b, self.scratch, dtype=np.int32)
+            got = self.gather(idx)
+            self.scatter(idx, got)
+        zero = {n: np.zeros(shape, dtype=dtype)
+                for n, (shape, dtype) in self._specs.items()}
+        self.write_row(0, zero)  # slot 0 is zeros anyway

@@ -37,8 +37,9 @@ from ..executor import Executor, as_numpy
 from ..trainer import check_and_get_place
 from .buckets import bucket_for, ladder, pad_rows
 
-__all__ = ["ServeConfig", "Server", "ServeError", "ServerOverloaded",
-           "ServerClosed", "ServerDraining", "SERVE_MS_BUCKETS"]
+__all__ = ["ServeConfig", "Server", "ModelSet", "ServeError",
+           "ServerOverloaded", "ServerClosed", "ServerDraining",
+           "UnknownModel", "SERVE_MS_BUCKETS"]
 
 # serving latencies live well below training-step scale: extend the
 # monitor's default ms ladder downward so sub-ms queue/pad phases and
@@ -85,6 +86,11 @@ class ServerDraining(ServerClosed):
     is going away" handler (HTTP 503, router failover) already does the
     right thing; the distinct type lets frontends add the
     `Connection: close` hint."""
+
+
+class UnknownModel(ServeError):
+    """The request named a model this server does not host — the HTTP
+    frontend's 404 (deterministic, never retried by the fleet router)."""
 
 
 class ServeConfig:
@@ -284,10 +290,15 @@ class Server:
     """
 
     def __init__(self, program, feed_names, fetch_list, place=None,
-                 scope=None, config=None):
+                 scope=None, config=None, model=None):
         if not isinstance(program, Program):
             raise TypeError("program must be a Program")
         self.program = program
+        # optional model name: when set, queue/latency/SLO series are
+        # ALSO emitted with a {model=} label (the unlabeled aggregates
+        # stay, so existing dashboards keep working) and stats() carries
+        # a per-model block the fleet's SLO-weighted routing reads
+        self.model = None if model is None else str(model)
         self.config = config or ServeConfig()
         self.place = check_and_get_place(place)
         self.scope = scope if scope is not None else Scope()
@@ -594,11 +605,24 @@ class Server:
                 f"{self.config.max_batch}; split it client-side")
         return out, rows
 
-    def submit(self, feed):
+    def resolve_model(self, name=None):
+        """-> self when `name` is this server's model (or None);
+        UnknownModel otherwise — the single-model end of the multi-model
+        HTTP contract."""
+        if name is None or name == self.model:
+            return self
+        raise UnknownModel(
+            f"unknown model {name!r}; this server hosts "
+            f"{self.model!r}" if self.model else
+            f"unknown model {name!r}; this server is unnamed")
+
+    def submit(self, feed, model=None):
         """Enqueue one request; returns a concurrent.futures.Future that
         resolves to the fetch-list arrays sliced to the request's rows.
         Raises ServerOverloaded beyond max_queue_rows (bounded
-        backpressure) and ServerClosed after stop()."""
+        backpressure), ServerClosed after stop(), and UnknownModel when
+        `model` names something this server does not host."""
+        self.resolve_model(model)
         if self._stop:
             raise ServerClosed("server is stopped")
         if self._draining:
@@ -619,13 +643,16 @@ class Server:
             self._own["rejected"].inc()
             reg.counter("serve_rejected_total",
                         help="requests rejected by admission control").inc()
+            if self.model is not None:
+                reg.counter("serve_rejected_total", model=self.model).inc()
             _trace.maybe_dump("server_overloaded")
             raise
         self._own["requests"].inc()
         reg.counter("serve_requests_total",
                     help="requests admitted to the serve queue").inc()
-        self._gauge("serve_queue_rows",
-                    help="rows currently queued").set(self._queue.rows)
+        if self.model is not None:
+            reg.counter("serve_requests_total", model=self.model).inc()
+        self._set_queue_gauge()
         return req.future
 
     def infer(self, feed, timeout=None):
@@ -645,17 +672,25 @@ class Server:
                 if self._stop or (self._draining and self._queue.drained):
                     return
                 continue
-            req.t_picked = time.perf_counter()
+            if req.t_picked is None:
+                req.t_picked = time.perf_counter()
             batch, rows = [req], req.rows
-            deadline = req.t_picked + self.config.max_wait_ms / 1000.0
+            # fairness: the batching window is anchored at the OLDEST
+            # member's submit time, never re-opened. A request carried
+            # over from a previous batch (held) or aged in the queue has
+            # already spent its window — it ages AHEAD of fresh arrivals
+            # and flushes at once (after a non-blocking greedy fill from
+            # the backlog) instead of waiting out a fresh max_wait_ms,
+            # which a steady trickle of full buckets could previously
+            # impose on a held underfull remainder over and over.
+            deadline = req.t_submit + self.config.max_wait_ms / 1000.0
             while rows < self.config.max_batch and not self._stop:
                 remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
-                nxt = self._queue.get(timeout=remaining)
+                nxt = self._queue.get(timeout=max(0.0, remaining))
                 if nxt is None:
                     break
-                nxt.t_picked = time.perf_counter()
+                if nxt.t_picked is None:
+                    nxt.t_picked = time.perf_counter()
                 if rows + nxt.rows > self.config.max_batch:
                     held = nxt  # opens the NEXT batch
                     break
@@ -686,8 +721,7 @@ class Server:
                       buckets=self.config.buckets).observe(rows)
         # the batch left the request queue: keep the depth gauge live for
         # /metrics scrapes, not just high-water marks from submit()
-        self._gauge("serve_queue_rows",
-                    help="rows currently queued").set(self._queue.rows)
+        self._set_queue_gauge()
         if self._stop:
             self._fail_batch(batch, ServerClosed("server stopped"))
             return
@@ -754,6 +788,14 @@ class Server:
     def _gauge(self, name, help=""):
         return monitor.registry().gauge(name, help=help)
 
+    def _set_queue_gauge(self):
+        rows = self._queue.rows
+        self._gauge("serve_queue_rows",
+                    help="rows currently queued").set(rows)
+        if self.model is not None:
+            monitor.registry().gauge("serve_queue_rows",
+                                     model=self.model).set(rows)
+
     def _record_request(self, req, pad_s, dispatch_s, readback_s, done,
                         replica, batch_ctx=None, t_pad=None,
                         t_dispatch=None, t_readback=None):
@@ -764,6 +806,9 @@ class Server:
         reg.histogram("serve_request_ms",
                       help="submit-to-result request latency",
                       buckets=SERVE_MS_BUCKETS).observe(total_ms)
+        if self.model is not None:
+            reg.histogram("serve_request_ms", buckets=SERVE_MS_BUCKETS,
+                          model=self.model).observe(total_ms)
         for phase, ms in (("queue", queue_ms), ("pad", pad_s * 1000.0),
                           ("dispatch", dispatch_s * 1000.0),
                           ("readback", readback_s * 1000.0)):
@@ -780,6 +825,9 @@ class Server:
             self._own["slo_violations"].inc()
             reg.counter("serve_slo_violations_total",
                         help="requests exceeding ServeConfig.slo_ms").inc()
+            if self.model is not None:
+                reg.counter("serve_slo_violations_total",
+                            model=self.model).inc()
         if req.tctx is not None and _trace.enabled():
             # retroactive lifecycle spans under the identity allocated at
             # submit(): root request span (linked to the batch that
@@ -848,7 +896,18 @@ class Server:
         rows = self._own["rows"].value
         padded = self._own["padded_rows"].value
         cache = self._cache_aggregate()
+        models = {}
+        if self.model is not None:
+            models[self.model] = {
+                "slo_ms": self.config.slo_ms,
+                "queue_rows": self._queue.rows,
+                "requests": self._own["requests"].value,
+                "p99_ms": pct[99],
+                "slo_violations": self._own["slo_violations"].value,
+            }
         return {
+            "model": self.model,
+            "models": models,
             "ready": self.ready(),
             "state": self.state(),
             "draining": self.draining(),
@@ -869,4 +928,121 @@ class Server:
                 self._cache_entries() - self._warm_entries,
             "compile_cache_misses": cache["misses"],
             "compile_cache": cache,
+        }
+
+
+class ModelSet:
+    """N named one-shot Servers behind one frontend surface.
+
+    The multi-model contract for the classic batcher: each model keeps
+    its own Server (own queue, buckets, compile caches, SLO), and the
+    set dispatches `submit(feed, model=...)` by name — the same surface
+    the HTTP frontend and fleet router speak, so a ModelSet drops in
+    anywhere a Server does. For iteration-level scheduling across
+    models inside ONE step loop, use serve.continuous.ContinuousServer.
+    """
+
+    def __init__(self, servers, default=None):
+        if not servers:
+            raise ValueError("ModelSet needs at least one server")
+        self.servers = dict(servers)
+        for name, srv in self.servers.items():
+            if srv.model is None:
+                srv.model = str(name)
+        self.default = str(default) if default is not None \
+            else next(iter(self.servers))
+        if self.default not in self.servers:
+            raise ValueError(f"default {self.default!r} not in servers")
+
+    @property
+    def models(self):
+        return self.servers
+
+    def resolve_model(self, name=None):
+        if name is None:
+            return self.servers[self.default]
+        srv = self.servers.get(str(name))
+        if srv is None:
+            raise UnknownModel(
+                f"unknown model {name!r}; hosting "
+                f"{sorted(self.servers)}")
+        return srv
+
+    def submit(self, feed, model=None):
+        return self.resolve_model(model).submit(feed)
+
+    def infer(self, feed, model=None, timeout=None):
+        return self.submit(feed, model=model).result(timeout=timeout)
+
+    # -- lifecycle (fan-out) --------------------------------------------
+    def start(self, warm=True):
+        for srv in self.servers.values():
+            srv.start(warm=warm)
+        return self
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        return False
+
+    def stop(self):
+        for srv in self.servers.values():
+            srv.stop()
+
+    def drain(self, timeout=30.0):
+        ok = True
+        for srv in self.servers.values():
+            ok = srv.drain(timeout=timeout) and ok
+        return ok
+
+    def ready(self):
+        return all(srv.ready() for srv in self.servers.values())
+
+    def draining(self):
+        return any(srv.draining() for srv in self.servers.values())
+
+    def state(self):
+        """Worst-of for /healthz: serving only when EVERY model serves;
+        draining while any drains; otherwise the first non-serving
+        member's state."""
+        states = [srv.state() for srv in self.servers.values()]
+        if all(s == "serving" for s in states):
+            return "serving"
+        if any(s == "draining" for s in states):
+            return "draining"
+        for s in states:
+            if s != "serving":
+                return s
+        return "serving"
+
+    def stats(self):
+        per_model = {n: srv.stats() for n, srv in self.servers.items()}
+        models = {}
+        for n, st in per_model.items():
+            models.update(st.get("models") or
+                          {n: {"slo_ms": st.get("slo_ms"),
+                               "queue_rows": st.get("queue_rows"),
+                               "requests": st.get("requests"),
+                               "p99_ms": st.get("p99_ms"),
+                               "slo_violations":
+                                   st.get("slo_violations")}})
+        return {
+            "ready": self.ready(),
+            "state": self.state(),
+            "draining": self.draining(),
+            "default_model": self.default,
+            "queue_rows": sum(st["queue_rows"]
+                              for st in per_model.values()),
+            "requests": sum(st["requests"] for st in per_model.values()),
+            "rejected": sum(st["rejected"] for st in per_model.values()),
+            "slo_violations": sum(st["slo_violations"]
+                                  for st in per_model.values()),
+            "steady_state_compiles": sum(st["steady_state_compiles"]
+                                         for st in per_model.values()),
+            "compile_entries": sum(st["compile_entries"]
+                                   for st in per_model.values()),
+            "models": models,
         }

@@ -50,11 +50,24 @@ __all__ = ["AutoscalerConfig", "Autoscaler", "ProcessReplicaSpawner"]
 
 
 class AutoscalerConfig:
+    """`model_targets` maps model name -> per-model p99 target (ms).
+    Each named model gets its OWN latency window over the router's
+    fleet_request_ms{model=} series; a breach on ANY of them arms
+    scale-out even while the aggregate window sits under target — a
+    high-traffic cold model can no longer mask a hot one. Scale-in
+    additionally requires every named model calm (below its target *
+    hysteresis)."""
+
     def __init__(self, target_p99_ms=500.0, high_queue_rows=None,
                  min_replicas=1, max_replicas=4, scale_step=1,
                  breach_rounds=2, calm_rounds=6, hysteresis=0.5,
                  cooldown_out_s=5.0, cooldown_in_s=30.0,
-                 interval_s=1.0, drain_timeout_s=60.0):
+                 interval_s=1.0, drain_timeout_s=60.0,
+                 model_targets=None):
+        self.model_targets = {str(k): float(v) for k, v in
+                              (model_targets or {}).items()}
+        if any(v <= 0 for v in self.model_targets.values()):
+            raise ValueError("model_targets values must be > 0")
         self.target_p99_ms = float(target_p99_ms)
         self.high_queue_rows = (None if high_queue_rows is None
                                 else float(high_queue_rows))
@@ -126,6 +139,7 @@ class Autoscaler:
         self.config = config if config is not None else AutoscalerConfig()
         self._clock = clock if clock is not None else time.monotonic
         self._prev_window = None
+        self._prev_model_windows = {}  # model -> last cumulative counts
         self._breach = 0
         self._calm = 0
         self._last_out = None
@@ -135,6 +149,8 @@ class Autoscaler:
         self._thread = None
         self.last_p99 = None
         self.last_queue = 0.0
+        self.last_model_p99 = {}   # model -> windowed p99 (or None)
+        self.last_hot_models = []  # models breaching their target now
         self.scale_outs = 0
         self.scale_ins = 0
         self.drain_reports = []
@@ -174,16 +190,37 @@ class Autoscaler:
         queue = sum(r.queue_rows for r in routable)
         return p99, queue, routable
 
+    def _model_signals(self):
+        """{model: windowed p99 or None} for every model in
+        model_targets, each over its OWN fleet_request_ms{model=}
+        series — one hot model stays visible through any amount of
+        cold-model traffic in the aggregate window."""
+        out = {}
+        for model in self.config.model_targets:
+            edges, cum = self.router.latency_window(model=model)
+            out[model] = _window_p99(
+                edges, self._prev_model_windows.get(model), cum)
+            self._prev_model_windows[model] = dict(cum)
+        return out
+
     def tick(self):
         cfg = self.config
         now = self._clock()
         p99, queue, routable = self._signals()
+        model_p99 = self._model_signals()
+        hot_models = [m for m, v in model_p99.items()
+                      if v is not None and v > cfg.model_targets[m]]
         self.last_p99, self.last_queue = p99, queue
+        self.last_model_p99 = model_p99
+        self.last_hot_models = hot_models
         live = len(routable)
         hot = (p99 is not None and p99 > cfg.target_p99_ms) or \
             (cfg.high_queue_rows is not None
-             and queue >= cfg.high_queue_rows)
-        cold = queue == 0 and \
+             and queue >= cfg.high_queue_rows) or bool(hot_models)
+        models_calm = all(
+            v is None or v <= cfg.model_targets[m] * cfg.hysteresis
+            for m, v in model_p99.items())
+        cold = queue == 0 and models_calm and \
             (p99 is None or p99 <= cfg.target_p99_ms * cfg.hysteresis)
         if hot:
             self._breach += 1
@@ -255,9 +292,15 @@ class Autoscaler:
             reg.gauge("fleet_autoscaler_window_p99_ms",
                       help="windowed router p99 driving scale "
                            "decisions").set(p99)
+        for m, v in self.last_model_p99.items():
+            if v is not None:
+                reg.gauge("fleet_autoscaler_window_p99_ms",
+                          model=m).set(v)
 
     def describe(self):
         return {"p99_ms": self.last_p99, "queue_rows": self.last_queue,
+                "model_p99_ms": dict(self.last_model_p99),
+                "hot_models": list(self.last_hot_models),
                 "breach_rounds": self._breach, "calm_rounds": self._calm,
                 "scale_outs": self.scale_outs,
                 "scale_ins": self.scale_ins,

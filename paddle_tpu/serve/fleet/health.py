@@ -171,6 +171,15 @@ class HealthProber:
                 if p99 is not None and float(p99) == float(p99) \
                         and float(p99) > self.degraded_p99_ms:
                     return True
+            # multi-model replicas advertise per-model SLOs: a replica
+            # blowing ONE hosted model's SLO by 2x is degraded even when
+            # its aggregate p99 (diluted by the other models) looks fine
+            for mst in (stats.get("models") or {}).values():
+                mp99, slo = mst.get("p99_ms"), mst.get("slo_ms")
+                if mp99 is not None and slo and \
+                        float(mp99) == float(mp99) and \
+                        float(mp99) > 2.0 * float(slo):
+                    return True
             compiles = float(stats.get("steady_state_compiles") or 0)
         except (TypeError, ValueError):
             return False

@@ -193,6 +193,11 @@ class Router:
 
         self._own_request_ms = monitor.Histogram(
             "fleet_request_ms", buckets=SERVE_MS_BUCKETS)
+        # per-model router-side latency, keyed lazily by the model names
+        # actually seen on the wire; the autoscaler windows each series
+        # independently so one hot model is visible through a cold one
+        self._own_model_ms = {}
+        self._model_lock = threading.Lock()
 
     # -- lifecycle ------------------------------------------------------
     def start(self):
@@ -218,11 +223,12 @@ class Router:
         self._own[own_name].inc()
         monitor.registry().counter(reg_name, help=help_).inc()
 
-    def _acquire(self, exclude):
+    def _acquire(self, exclude, model=None):
         """Next replica per policy whose breaker admits a request."""
         skip = set(exclude)
         while True:
-            rep = self.policy.pick(self.membership.candidates(skip))
+            rep = self.policy.pick(self.membership.candidates(skip),
+                                   model=model)
             if rep is None:
                 return None
             if rep.breaker.try_acquire():
@@ -250,7 +256,8 @@ class Router:
                 sp.set(status=status)
                 return status, rh, rb
 
-    def _hedged(self, rep, body, headers, timeout_s, parent_ctx, tried):
+    def _hedged(self, rep, body, headers, timeout_s, parent_ctx, tried,
+                model=None):
         """Race a second replica against a silent first attempt; first
         answer (success OR failure) wins, the loser is reaped off-path so
         its breaker outcome still lands. The loser's name goes into
@@ -275,7 +282,7 @@ class Router:
         try:
             winner = results.get(timeout=self.config.hedge_ms / 1000.0)
         except queue.Empty:
-            second = self._acquire(set(tried) | {rep.name})
+            second = self._acquire(set(tried) | {rep.name}, model=model)
             if second is not None:
                 self._counter("hedges", "fleet_hedges_total",
                               "hedged (raced) requests fired")
@@ -314,8 +321,11 @@ class Router:
                              name="fleet-reap", daemon=True).start()
         return winner
 
-    def route(self, body, headers=None):
-        """Route one POST /v1/infer body -> (status, headers, body)."""
+    def route(self, body, headers=None, model=None):
+        """Route one POST /v1/infer body -> (status, headers, body).
+        `model` (the request's "model" field, extracted by the frontend)
+        weights the replica pick by that model's SLO lag and labels the
+        latency observation."""
         cfg = self.config
         t_start = time.perf_counter()
         deadline = t_start + cfg.request_deadline_ms / 1000.0
@@ -335,7 +345,7 @@ class Router:
                     last = (504, {}, _err_body("request deadline "
                                                "exceeded"))
                     break
-                rep = self._acquire(tried)
+                rep = self._acquire(tried, model=model)
                 if rep is None:
                     break
                 attempts += 1
@@ -344,7 +354,8 @@ class Router:
                 try:
                     if attempts == 1 and cfg.hedge_ms is not None:
                         rep, out, err = self._hedged(
-                            rep, body, headers, timeout_s, fsp.ctx, tried)
+                            rep, body, headers, timeout_s, fsp.ctx,
+                            tried, model=model)
                     else:
                         out = self._send(rep, body, headers, timeout_s,
                                          attempts - 1, fsp.ctx, False)
@@ -357,7 +368,7 @@ class Router:
                     status, rh, rb = out
                     fsp.set(status=status, attempts=attempts,
                             replica=rep.name)
-                    self._observe(t_start)
+                    self._observe(t_start, model)
                     out_headers = _end_to_end(rh)
                     out_headers["X-Fleet-Replica"] = rep.name
                     out_headers["X-Fleet-Attempts"] = str(attempts)
@@ -368,7 +379,7 @@ class Router:
                     self._counter("failures", "fleet_router_failures_total",
                                   "requests the router could not place")
                     fsp.set(status=502, error=type(err).__name__)
-                    self._observe(t_start)
+                    self._observe(t_start, model)
                     return 502, {"X-Fleet-Attempts": str(attempts)}, \
                         _err_body(f"{type(err).__name__}: {err}")
                 # retryable: 503 from the replica or a transient fault
@@ -393,14 +404,14 @@ class Router:
             self._counter("failures", "fleet_router_failures_total",
                           "requests the router could not place")
             fsp.set(status=status, attempts=attempts)
-            self._observe(t_start)
+            self._observe(t_start, model)
             out_headers = {"X-Fleet-Attempts": str(attempts)}
             for k in ("Retry-After", "Connection"):
                 if k in rh:
                     out_headers[k] = rh[k]
             return status, out_headers, rb
 
-    def _observe(self, t_start):
+    def _observe(self, t_start, model=None):
         ms = (time.perf_counter() - t_start) * 1000.0
         self._own_request_ms.observe(ms)
         from ..engine import SERVE_MS_BUCKETS
@@ -408,6 +419,25 @@ class Router:
         monitor.registry().histogram(
             "fleet_request_ms", help="router-side request latency",
             buckets=SERVE_MS_BUCKETS).observe(ms)
+        if model is not None:
+            self._model_hist(model).observe(ms)
+            monitor.registry().histogram(
+                "fleet_request_ms", buckets=SERVE_MS_BUCKETS,
+                model=str(model)).observe(ms)
+
+    def _model_hist(self, model):
+        """Per-model router-side latency histogram (lazily created; the
+        autoscaler windows these for per-model scale signals)."""
+        from ..engine import SERVE_MS_BUCKETS
+
+        with self._model_lock:
+            h = self._own_model_ms.get(model)
+            if h is None:
+                h = monitor.Histogram(
+                    f"fleet_request_ms[{model}]",
+                    buckets=SERVE_MS_BUCKETS)
+                self._own_model_ms[model] = h
+            return h
 
     # -- draining -------------------------------------------------------
     def drain(self, name, timeout_s=30.0, poll_interval_s=0.1):
@@ -450,13 +480,28 @@ class Router:
         ps = ps or (50, 95, 99)
         return self._own_request_ms.percentiles(*ps)
 
-    def latency_window(self):
+    def latency_window(self, model=None):
         """(bucket_edges, cumulative_counts) of the router-side request
-        latency histogram. The autoscaler diffs successive snapshots for
-        a WINDOWED p99 — the cumulative percentiles answer "since boot",
-        which is useless as a control signal once history piles up."""
-        snap = self._own_request_ms.snapshot()
-        return self._own_request_ms.buckets, snap["buckets"]
+        latency histogram — aggregate, or one model's series when
+        `model` is given (empty counts for a model never seen). The
+        autoscaler diffs successive snapshots for a WINDOWED p99 — the
+        cumulative percentiles answer "since boot", which is useless as
+        a control signal once history piles up."""
+        if model is None:
+            hist = self._own_request_ms
+        else:
+            with self._model_lock:
+                hist = self._own_model_ms.get(model)
+            if hist is None:
+                return self._own_request_ms.buckets, {}
+        snap = hist.snapshot()
+        return hist.buckets, snap["buckets"]
+
+    def models_seen(self):
+        """Model names that have crossed this router (for per-model
+        autoscaler windows and dashboards)."""
+        with self._model_lock:
+            return sorted(self._own_model_ms)
 
     def stats(self):
         pct = self.latency_percentiles(50, 95, 99)
@@ -473,6 +518,9 @@ class Router:
             "deadline_exceeded": self._own["deadline_exceeded"].value,
             "retry_budget_tokens": self.budget.tokens,
             "p50_ms": pct[50], "p95_ms": pct[95], "p99_ms": pct[99],
+            "models": {
+                m: {"p99_ms": self._model_hist(m).percentiles(99)[99]}
+                for m in self.models_seen()},
         }
 
 
@@ -527,8 +575,19 @@ def make_fleet_http(router, host="127.0.0.1", port=8100):
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)
             if self.path == "/v1/infer":
+                # best-effort "model" extraction off the wire body: a
+                # malformed body still routes (the replica owns the 400)
+                model = None
+                try:
+                    payload = json.loads(body or b"{}")
+                    if isinstance(payload, dict):
+                        m = payload.get("model")
+                        if isinstance(m, str) and m:
+                            model = m
+                except ValueError:
+                    pass
                 status, hdrs, rbody = rt.route(body, headers={
-                    "Content-Type": "application/json"})
+                    "Content-Type": "application/json"}, model=model)
                 # route() forwards the replica's Content-Type; lift it
                 # out so _reply doesn't emit the header twice
                 ctype = hdrs.pop("Content-Type", "application/json")

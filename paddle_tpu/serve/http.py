@@ -1,9 +1,16 @@
 """Stdlib HTTP frontend for a Server — no framework dependency.
 
 Endpoints:
-    POST /v1/infer    {"inputs": {name: nested-list}}  ->
+    POST /v1/infer    {"inputs": {name: nested-list},
+                       "model": "name"?, "steps": K?, "seed": s?}  ->
                       {"outputs": [nested-list, ...]}  (sliced to the
-                      request's rows). Failure mapping is load-balancer
+                      request's rows). "model" picks a hosted model on a
+                      multi-model engine (ModelSet / ContinuousServer);
+                      omitted = the engine's default model; an unknown
+                      name is 404 (deterministic — the fleet router
+                      never retries it). "steps"/"seed" drive a K-step
+                      decode on a continuous engine (400 on a one-shot
+                      engine). Failure mapping is load-balancer
                       shaped: 503 + Retry-After on backpressure
                       rejection (ServerOverloaded — the replica is
                       healthy but full, come back), 503 +
@@ -44,7 +51,7 @@ import numpy as np
 from .. import monitor
 from .. import trace as _trace
 from .engine import (ServeError, ServerClosed, ServerDraining,
-                     ServerOverloaded)
+                     ServerOverloaded, UnknownModel)
 
 __all__ = ["serve_http", "make_http_server", "TRACE_HEADER",
            "SPAN_HEADER"]
@@ -144,15 +151,41 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(length) or b"{}")
-                feed = _json_feed(payload, engine)
-                fut = engine.submit(feed)
+                model = payload.get("model") \
+                    if isinstance(payload, dict) else None
+                if model is not None and not isinstance(model, str):
+                    raise ValueError('"model" must be a string')
+                # resolve first: feed dtypes/shapes come from the NAMED
+                # model, and an unknown name must 404 before any feed
+                # parsing can turn it into a 400
+                target = engine.resolve_model(model)
+                feed = _json_feed(payload, target)
+                steps = payload.get("steps")
+                if getattr(engine, "is_continuous", False):
+                    fut = engine.submit(
+                        feed, model=model,
+                        steps=1 if steps is None else int(steps),
+                        seed=int(payload.get("seed", 0)))
+                elif steps is not None and int(steps) != 1:
+                    raise ValueError(
+                        '"steps" needs a continuous engine '
+                        '(serve.continuous.ContinuousServer)')
+                else:
+                    fut = engine.submit(feed, model=model)
+            except UnknownModel as e:
+                sp.set(status=404)
+                self._reply_json(404, {"error": str(e)})
+                return
             except ServerOverloaded as e:
                 # full, not broken: tell the client (or router) to retry
                 # elsewhere / later — one batching window is the honest
                 # earliest time this replica could admit again
                 sp.set(status=503)
-                retry_s = max(1, int(-(-engine.config.max_wait_ms
-                                       // 1000.0)))
+                cfg = getattr(engine, "config", None)
+                wait_ms = getattr(cfg, "max_wait_ms", None)
+                if wait_ms is None:
+                    wait_ms = getattr(cfg, "idle_wait_ms", 1000.0)
+                retry_s = max(1, int(-(-wait_ms // 1000.0)))
                 self._reply_json(503, {"error": str(e)},
                                  headers={"Retry-After": str(retry_s)})
                 return

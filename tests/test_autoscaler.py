@@ -239,3 +239,96 @@ def test_scale_in_victim_prefers_lifo_then_shallowest_queue():
     assert scale_in_victim(reps, prefer=["as0"]) == "as0"
     assert scale_in_victim(reps, prefer=["gone"]) == "r1"  # shallowest
     assert scale_in_victim([], prefer=["as0"]) is None
+
+
+# ---------------------------------------------------------------------------
+# per-model latency windows
+# ---------------------------------------------------------------------------
+
+class _ModelRouter(_FakeRouter):
+    """Adds scripted per-model windows on top of the aggregate one."""
+
+    def __init__(self, clock):
+        super().__init__(clock)
+        self.model_cum = {}
+
+    def latency_window(self, model=None):
+        if model is None:
+            return self.edges, dict(self.cum)
+        return self.edges, dict(self.model_cum.get(model) or
+                                _cum(0, 0, 0, 0))
+
+    def observe_model(self, model, fast=0, slow=0):
+        cum = self.model_cum.setdefault(model, _cum(0, 0, 0, 0))
+        cum[10.0] += fast
+        for k in (100.0, 1000.0, "+Inf"):
+            cum[k] += fast + slow
+
+
+def _model_fleet(clock, names=("r0", "r1")):
+    r = _ModelRouter(clock)
+    for name in names:
+        rep = r.membership.add(name, f"{name}:1")
+        r.membership.set_state(rep, HEALTHY)
+    return r
+
+
+def test_model_targets_validation():
+    with pytest.raises(ValueError):
+        AutoscalerConfig(model_targets={"m": 0})
+    cfg = AutoscalerConfig(model_targets={"m": 20})
+    assert cfg.model_targets == {"m": 20.0}
+
+
+def test_hot_model_scales_out_through_cold_aggregate():
+    """One model breaching its own target fires scale-out even while a
+    flood of cold-model traffic holds the aggregate p99 under the fleet
+    target — the exact conflation per-model windows exist to break."""
+    now = [0.0]
+    r = _model_fleet(lambda: now[0])
+    sp = _FakeSpawner()
+    a = Autoscaler(r, sp, AutoscalerConfig(
+        target_p99_ms=50.0, model_targets={"hot": 20.0},
+        min_replicas=2, max_replicas=4, breach_rounds=2,
+        calm_rounds=4, cooldown_out_s=1.0),
+        clock=lambda: now[0])
+    for rnd in range(2):
+        # aggregate: 1000 fast + the 5 slow -> windowed p99 <= 10 ms,
+        # far under the 50 ms fleet target
+        r.observe(fast=1000, slow=5)
+        # the hot model's own window: all 5 slow -> p99 ~ 99 ms > 20
+        r.observe_model("hot", slow=5)
+        now[0] += 1.0
+        a.tick()
+    assert a.last_p99 is not None and a.last_p99 <= 50.0
+    assert a.last_hot_models == ["hot"]
+    assert a.describe()["hot_models"] == ["hot"]
+    assert a.scale_outs == 1
+    assert sp.seq == 1
+    reg = monitor.registry().snapshot()
+    assert reg['fleet_autoscaler_window_p99_ms{model="hot"}'] > 20.0
+
+
+def test_model_above_half_target_blocks_scale_in():
+    """Scale-in needs every named model calm: a model sitting between
+    hysteresis * target and target holds the dead band."""
+    now = [0.0]
+    r = _model_fleet(lambda: now[0], names=("r0", "r1", "r2"))
+    sp = _FakeSpawner()
+    a = Autoscaler(r, sp, AutoscalerConfig(
+        target_p99_ms=500.0, model_targets={"m": 120.0},
+        min_replicas=1, max_replicas=4, breach_rounds=2,
+        calm_rounds=1, cooldown_in_s=0.0), clock=lambda: now[0])
+    # m's window p99 ~ 99 ms: under its 120 ms target (not hot) but
+    # over 120 * 0.5 (not calm) -> dead band, no scale-in
+    r.observe(fast=100, slow=5)
+    r.observe_model("m", slow=5)
+    now[0] += 1.0
+    a.tick()
+    assert a.last_hot_models == []
+    assert a.scale_ins == 0
+    assert a.describe()["calm_rounds"] == 0
+    # a genuinely calm round (no traffic anywhere) arms scale-in
+    now[0] += 1.0
+    a.tick()
+    assert a.scale_ins == 1

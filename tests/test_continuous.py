@@ -1,0 +1,465 @@
+"""paddle_tpu.serve.continuous: iteration-level batching.
+
+Covers the slot bank (ladder addressing, verbatim gather/scatter), the
+dataflow branch partitioner, the ContinuousServer step loop (join/leave
+mid-batch, zero steady-state compiles, drain/stop semantics, per-model
+SLO scheduling), the decode bitwise-parity guarantee, multi-model HTTP
+(the "model"/"steps" fields, 404 on unknown names), and the per-model
+metric labels the fleet layer consumes.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor, serve
+from paddle_tpu.serve.continuous import (ContinuousConfig,
+                                         ContinuousServer, SlotBank,
+                                         independent_branches)
+from paddle_tpu.serve.http import make_http_server
+
+
+@pytest.fixture(autouse=True)
+def _fresh_monitor():
+    monitor.reset()
+    yield
+    monitor.reset()
+
+
+def _decode_program(feat=4, seed=0):
+    """A one-step decode cell: y = tanh(fc(x)), state x <- y. Returns
+    (prog, scope, x_name, y_var)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[feat], dtype="float32")
+        y = fluid.layers.fc(input=x, size=feat, act="tanh")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    return prog, scope, "x", y
+
+
+def _server(models=(("m", 50.0),), max_slots=4, feat=4, **cfg):
+    srv = ContinuousServer(place=fluid.CPUPlace(),
+                           config=ContinuousConfig(max_slots=max_slots,
+                                                   **cfg))
+    progs = {}
+    for name, slo in models:
+        prog, scope, xn, y = _decode_program(feat=feat, seed=hash(name))
+        srv.add_model(name, prog, [xn], [y], state={xn: y.name},
+                      scope=scope, slo_ms=slo)
+        progs[name] = (prog, scope, y)
+    return srv, progs
+
+
+def _solo_decode(prog, scope, y, row, steps):
+    """Reference: the same K-step decode replayed solo through a plain
+    jitted Executor (bitwise comparator for the continuous path)."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    cur = np.asarray(row, dtype="float32").reshape(1, -1)
+    out = []
+    with fluid.scope_guard(scope):
+        for _ in range(steps):
+            cur = exe.run(prog, feed={"x": cur}, fetch_list=[y])[0]
+            out.append(cur[0])
+    return np.stack(out, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# slot bank
+# ---------------------------------------------------------------------------
+
+def test_slot_bank_alloc_release_ladder():
+    bank = SlotBank(4, {"x": ((3,), "float32")})
+    assert bank.rungs == (1, 2, 4)
+    assert bank.free_slots == 4
+    s0 = bank.alloc("r0")
+    s1 = bank.alloc("r1")
+    assert (s0, s1) == (0, 1)  # lowest slot first: stable lane order
+    assert bank.active_slots() == (0, 1)
+    bank.release(s0)
+    assert bank.active_slots() == (1,)
+    assert bank.alloc("r2") == 0  # freed slot is reused
+    for r in ("r3", "r4"):
+        bank.alloc(r)
+    assert bank.free_slots == 0
+    assert bank.alloc("r5") is None  # full bank refuses, never evicts
+
+
+def test_slot_bank_lane_index_pads_with_scratch():
+    bank = SlotBank(4, {"x": ((2,), "float32")})
+    bank.alloc("a")
+    bank.alloc("b")
+    bank.release(0)
+    idx = bank.lane_index(2)
+    assert idx.tolist() == [1, bank.scratch]
+
+
+def test_slot_bank_roundtrip_is_verbatim():
+    bank = SlotBank(2, {"x": ((3,), "float32")})
+    s = bank.alloc("a")
+    row = np.array([1.5, -2.25, 3.125], dtype="float32")
+    bank.write_row(s, {"x": row})
+    idx = bank.lane_index(1)
+    got = np.asarray(bank.gather(idx)["x"])
+    assert np.array_equal(got[0], row)
+    bank.scatter(idx, {"x": got * 2})
+    got2 = np.asarray(bank.gather(idx)["x"])
+    assert np.array_equal(got2[0], row * 2)
+
+
+def test_slot_bank_rng_rows_track_seed_and_step():
+    bank = SlotBank(2, {"x": ((1,), "float32")})
+    s = bank.alloc("a", seed=7)
+    bank.steps[s] = 3
+    rows = bank.rng_rows(bank.lane_index(2))
+    assert rows.dtype == np.uint32
+    assert rows[0].tolist() == [7, 3]
+    assert rows[1].tolist() == [0, 0]  # scratch lane: inert key
+
+
+# ---------------------------------------------------------------------------
+# inter-op branch partitioning
+# ---------------------------------------------------------------------------
+
+def test_independent_branches_partitions_disjoint_heads():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        a = fluid.layers.fc(input=x, size=3)
+        b = fluid.layers.fc(input=x, size=2)
+    groups = independent_branches(prog, ["x"], [a.name, b.name])
+    assert sorted(map(sorted, groups)) == [[0], [1]]
+
+
+def test_independent_branches_groups_shared_subgraph():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4)
+        a = fluid.layers.fc(input=h, size=3)
+        b = fluid.layers.fc(input=h, size=2)
+    groups = independent_branches(prog, ["x"], [a.name, b.name])
+    assert sorted(map(sorted, groups)) == [[0, 1]]
+
+
+# ---------------------------------------------------------------------------
+# continuous scheduling
+# ---------------------------------------------------------------------------
+
+def test_continuous_basic_decode_and_zero_compiles():
+    srv, progs = _server()
+    with srv:
+        rows = [np.random.RandomState(i).randn(4).astype("float32")
+                for i in range(3)]
+        futs = [srv.submit({"x": r}, steps=4) for r in rows]
+        res = [f.result(timeout=30) for f in futs]
+    for r in res:
+        assert r[0].shape == (4, 4)
+    st = srv.stats()
+    assert st["steady_state_compiles"] == 0
+    assert st["models"]["m"]["completed"] == 3
+
+
+def test_continuous_join_midstream_no_head_of_line_blocking():
+    """A short request submitted while a long stream is mid-decode rides
+    the running batch instead of waiting for the stream to finish."""
+    srv, progs = _server(max_slots=4)
+    srv.start(warm=True, loop=False)  # deterministic: we drive steps
+    try:
+        long_fut = srv.submit(
+            {"x": np.ones(4, dtype="float32")}, steps=64)
+        for _ in range(5):
+            srv.step_once()
+        short_fut = srv.submit(
+            {"x": np.zeros(4, dtype="float32")}, steps=1)
+        # ONE more turn of the loop must finish the short request — it
+        # joined the running batch at the very next step
+        srv.step_once()
+        assert short_fut.done()
+        assert not long_fut.done()
+        while not long_fut.done():
+            srv.step_once()
+        assert len(long_fut.result(timeout=5)[0]) == 64
+    finally:
+        srv.stop()
+
+
+def test_continuous_decode_parity_with_join_leave():
+    """Satellite: a K-step decode through the continuous scheduler —
+    with other requests joining and leaving the batch mid-stream — is
+    BITWISE identical to the same request replayed solo."""
+    srv, progs = _server(max_slots=4)
+    prog, scope, y = progs["m"]
+    srv.start(warm=True, loop=False)
+    try:
+        rng = np.random.RandomState(0)
+        r1 = rng.randn(4).astype("float32")
+        r2 = rng.randn(4).astype("float32")
+        r3 = rng.randn(4).astype("float32")
+        f1 = srv.submit({"x": r1}, steps=5)
+        srv.step_once()                       # batch={r1}
+        f2 = srv.submit({"x": r2}, steps=2)
+        srv.step_once()                       # batch={r1,r2}
+        srv.step_once()                       # r2 leaves after this step
+        f3 = srv.submit({"x": r3}, steps=3)
+        while not (f1.done() and f2.done() and f3.done()):
+            srv.step_once()
+        for row, fut, steps in ((r1, f1, 5), (r2, f2, 2), (r3, f3, 3)):
+            got = fut.result(timeout=5)[0]
+            ref = _solo_decode(prog, scope, y, row, steps)
+            assert got.shape == ref.shape
+            assert np.array_equal(got, ref), \
+                "continuous decode diverged from solo replay"
+    finally:
+        srv.stop()
+    assert srv.stats()["steady_state_compiles"] == 0
+
+
+def test_continuous_multi_model_isolation_and_least_lag():
+    """Two models on one server: separate compile caches, separate slot
+    banks, per-model stats — and the tighter-SLO model is not starved."""
+    srv, progs = _server(models=(("hot", 10.0), ("cold", 1000.0)))
+    srv.start(warm=True, loop=False)
+    try:
+        fh = srv.submit({"x": np.ones(4, dtype="float32")},
+                        model="hot", steps=3)
+        fc = srv.submit({"x": np.ones(4, dtype="float32")},
+                        model="cold", steps=3)
+        while not (fh.done() and fc.done()):
+            srv.step_once()
+        fh.result(timeout=5), fc.result(timeout=5)
+    finally:
+        srv.stop()
+    st = srv.stats()
+    assert set(st["models"]) == {"hot", "cold"}
+    for name in ("hot", "cold"):
+        ms = st["models"][name]
+        assert ms["completed"] == 1
+        assert ms["steady_state_compiles"] == 0
+    with pytest.raises(serve.UnknownModel):
+        srv.resolve_model("nope")
+
+
+def test_continuous_overload_and_bad_steps():
+    srv, _ = _server(max_slots=1, max_pending=1)
+    srv.start(warm=True, loop=False)  # nothing drains pending
+    try:
+        with pytest.raises(ValueError):
+            srv.submit({"x": np.ones(4, dtype="float32")}, steps=0)
+        with pytest.raises(serve.UnknownModel):
+            srv.submit({"x": np.ones(4, dtype="float32")}, model="zz")
+        srv.submit({"x": np.ones(4, dtype="float32")}, steps=4)
+        with pytest.raises(serve.ServerOverloaded):
+            srv.submit({"x": np.ones(4, dtype="float32")}, steps=4)
+        reg = monitor.registry()
+        assert reg.counter("serve_rejected_total").value == 1
+        assert reg.counter("serve_rejected_total", model="m").value == 1
+    finally:
+        srv.stop()
+
+
+def test_continuous_drain_finishes_backlog_stop_fails_it():
+    srv, _ = _server(max_slots=2)
+    srv.start(warm=True)
+    fut = srv.submit({"x": np.ones(4, dtype="float32")}, steps=8)
+    assert srv.drain(timeout=30)
+    assert fut.done() and len(fut.result()[0]) == 8
+    with pytest.raises(serve.ServerClosed):
+        srv.submit({"x": np.ones(4, dtype="float32")})
+
+    srv2, _ = _server(max_slots=2)
+    srv2.start(warm=True, loop=False)
+    fut2 = srv2.submit({"x": np.ones(4, dtype="float32")}, steps=8)
+    srv2.stop()  # never stepped: the request must fail, not hang
+    with pytest.raises(serve.ServerClosed):
+        fut2.result(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# per-model metric labels (fleet-facing satellite)
+# ---------------------------------------------------------------------------
+
+def test_per_model_series_do_not_conflate():
+    """Two models on one server: each model's labeled series counts only
+    its own traffic, while the unlabeled aggregates keep the totals."""
+    srv, _ = _server(models=(("a", 100.0), ("b", 100.0)))
+    with srv:
+        for _ in range(3):
+            srv.infer({"x": np.ones(4, dtype="float32")}, model="a",
+                      timeout=30)
+        srv.infer({"x": np.ones(4, dtype="float32")}, model="b",
+                  timeout=30)
+    reg = monitor.registry()
+    assert reg.counter("serve_requests_total", model="a").value == 3
+    assert reg.counter("serve_requests_total", model="b").value == 1
+    assert reg.counter("serve_requests_total").value == 4
+    pa = reg.histogram("serve_request_ms",
+                       model="a").snapshot()["count"]
+    pb = reg.histogram("serve_request_ms",
+                       model="b").snapshot()["count"]
+    assert (pa, pb) == (3, 1)
+    assert reg.histogram("serve_request_ms").snapshot()["count"] == 4
+
+
+def test_modelset_per_model_series_do_not_conflate():
+    """Same guarantee for the one-shot ModelSet path."""
+    def _one(name):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), \
+                fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.fc(input=x, size=3)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        return serve.Server(
+            prog, ["x"], [y], place=fluid.CPUPlace(), scope=scope,
+            config=serve.ServeConfig(max_batch=4, max_wait_ms=0.0),
+            model=name)
+
+    ms = serve.ModelSet({"a": _one("a"), "b": _one("b")})
+    with ms:
+        batch = np.ones((1, 4), dtype="float32")
+        ms.infer({"x": batch}, model="a", timeout=30)
+        ms.infer({"x": batch}, model="a", timeout=30)
+        ms.infer({"x": batch}, model="b", timeout=30)
+        with pytest.raises(serve.UnknownModel):
+            ms.submit({"x": batch}, model="zz")
+    reg = monitor.registry()
+    assert reg.counter("serve_requests_total", model="a").value == 2
+    assert reg.counter("serve_requests_total", model="b").value == 1
+    st = ms.stats()
+    assert st["requests"] == 3
+    assert set(st["models"]) == {"a", "b"}
+    assert st["models"]["a"]["requests"] == 2
+
+
+# ---------------------------------------------------------------------------
+# HTTP: the "model" / "steps" fields
+# ---------------------------------------------------------------------------
+
+def _post(port, obj, path="/v1/infer"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _serve_http(engine):
+    httpd = make_http_server(engine, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, httpd.server_address[1]
+
+
+def test_http_model_field_continuous():
+    srv, progs = _server(models=(("a", 100.0), ("b", 100.0)))
+    prog, scope, y = progs["a"]
+    with srv:
+        httpd, port = _serve_http(srv)
+        try:
+            row = [0.5, -1.0, 2.0, 0.25]
+            code, out = _post(port, {"inputs": {"x": row},
+                                     "model": "a", "steps": 3})
+            assert code == 200
+            got = np.asarray(out["outputs"][0], dtype="float32")
+            ref = _solo_decode(prog, scope, y,
+                               np.asarray(row, dtype="float32"), 3)
+            assert np.array_equal(got, ref)
+            # omitted model = default (first added)
+            code, _ = _post(port, {"inputs": {"x": row}})
+            assert code == 200
+            # unknown model is a deterministic 404, not a retryable 503
+            code, out = _post(port, {"inputs": {"x": row},
+                                     "model": "zz"})
+            assert code == 404
+            assert "zz" in out["error"]
+            code, out = _post(port, {"inputs": {"x": row}, "model": 7})
+            assert code == 400
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+def test_http_steps_rejected_on_oneshot_server():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    server = serve.Server(prog, ["x"], [y], place=fluid.CPUPlace(),
+                          scope=scope,
+                          config=serve.ServeConfig(max_batch=4),
+                          model="solo")
+    with server:
+        httpd, port = _serve_http(server)
+        try:
+            row = [[0.0, 1.0, 2.0, 3.0]]
+            code, _ = _post(port, {"inputs": {"x": row},
+                                   "model": "solo"})
+            assert code == 200
+            code, out = _post(port, {"inputs": {"x": row}, "steps": 4})
+            assert code == 400
+            assert "continuous" in out["error"]
+            code, _ = _post(port, {"inputs": {"x": row}, "model": "zz"})
+            assert code == 404
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# inter-op runner through the scheduler
+# ---------------------------------------------------------------------------
+
+def test_continuous_interop_two_head_model():
+    """A two-head model runs through InterOpRunner branches with results
+    identical to the single-dispatch path and no steady-state compile."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4, act="tanh")
+        head = fluid.layers.fc(input=x, size=4)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+
+    srv = ContinuousServer(place=fluid.CPUPlace(),
+                           config=ContinuousConfig(max_slots=2))
+    m = srv.add_model("two", prog, ["x"], [h, head],
+                      state={"x": h.name}, scope=scope, interop=True)
+    assert m.runner is not None and len(m.runner.groups) == 2
+    srv.start(warm=True)
+    try:
+        row = np.arange(4, dtype="float32")
+        out_h, out_head = srv.infer({"x": row}, steps=3, timeout=30)
+        with fluid.scope_guard(scope):
+            cur, ref_h, ref_head = row.reshape(1, 4), [], []
+            for _ in range(3):
+                rh, rhead = exe.run(prog, feed={"x": cur},
+                                    fetch_list=[h, head])
+                ref_h.append(rh[0])
+                ref_head.append(rhead[0])
+                cur = rh
+        assert np.array_equal(out_h, np.stack(ref_h))
+        assert np.array_equal(out_head, np.stack(ref_head))
+        assert srv.stats()["steady_state_compiles"] == 0
+        assert srv.model_stats("two")["interop_branches"] == 2
+    finally:
+        srv.stop()

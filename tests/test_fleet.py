@@ -924,3 +924,85 @@ def test_fleet_sigkill_subprocess_replica(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# per-model routing (SLO-weighted pick + labeled router metrics)
+# ---------------------------------------------------------------------------
+
+def test_policy_model_pick_is_slo_weighted():
+    ms = Membership()
+    a, b, c = _reps(ms, [("a", HEALTHY, 0), ("b", HEALTHY, 2),
+                         ("c", HEALTHY, 0)])
+    # a is idle but running model m at 5x its SLO; b has queue but m is
+    # healthy there; c does not host m at all
+    a.stats = {"queue_rows": 0,
+               "models": {"m": {"p99_ms": 500.0, "slo_ms": 100.0}}}
+    b.stats = {"queue_rows": 2,
+               "models": {"m": {"p99_ms": 100.0, "slo_ms": 100.0}}}
+    c.stats = {"queue_rows": 0, "models": {"other": {}}}
+    pol = LeastQueueDepthPolicy()
+    # model-less pick: plain least-queue (a and c tie at 0)
+    assert pol.pick(ms.candidates()).name in ("a", "c")
+    # model-aware pick: c is filtered out (doesn't host m), and a's SLO
+    # lag (score 0+5) loses to b's (score 2+1)
+    for _ in range(3):
+        assert pol.pick(ms.candidates(), model="m").name == "b"
+    # replicas predating multi-model (no "models" block) host everything
+    c.stats = {"queue_rows": 0}
+    assert pol.pick(ms.candidates(), model="m").name == "c"
+    # nobody hosts an unknown model: fall back to the full pool (the
+    # replica's own 404 is deterministic and unretried)
+    assert pol.pick(ms.candidates(), model="zz") is not None
+
+
+def test_router_per_model_latency_series():
+    def transport(ep, path, body, headers, timeout_s):
+        return 200, {}, b'{"outputs":[]}'
+
+    r = _router(transport)
+    for _ in range(3):
+        r.route(b'{"model": "a"}', model="a")
+    r.route(b"{}")
+    assert r.models_seen() == ["a"]
+    # the per-model window counts only a's traffic; aggregate keeps all
+    edges, cum_a = r.latency_window(model="a")
+    assert cum_a["+Inf"] == 3
+    _, cum_all = r.latency_window()
+    assert cum_all["+Inf"] == 4
+    # a model never seen yields an empty window, not a crash
+    _, cum_z = r.latency_window(model="zz")
+    assert cum_z == {}
+    reg = monitor.registry()
+    labeled = reg.histogram("fleet_request_ms", model="a").snapshot()
+    assert labeled["count"] == 3
+    assert r.stats()["models"]["a"]["p99_ms"] == \
+        r.stats()["models"]["a"]["p99_ms"]  # not NaN
+
+
+def test_fleet_http_extracts_model_for_routing():
+    """The fleet frontend pulls "model" off the wire body and the router
+    records the labeled series (the replica still owns parsing errors)."""
+    import json as _json
+    import threading as _threading
+    import urllib.request as _rq
+
+    def transport(ep, path, body, headers, timeout_s):
+        return 200, {}, b'{"outputs":[]}'
+
+    r = _router(transport)
+    httpd = make_fleet_http(r, port=0)
+    port = httpd.server_address[1]
+    _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        req = _rq.Request(
+            f"http://127.0.0.1:{port}/v1/infer",
+            data=_json.dumps({"inputs": {"x": [1.0]},
+                              "model": "chat"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with _rq.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    assert r.models_seen() == ["chat"]

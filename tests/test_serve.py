@@ -713,3 +713,34 @@ def test_inferencer_serve_convenience(tmp_path):
         np.testing.assert_allclose(got, want, rtol=1e-5)
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# batcher fairness: held/aged requests never get a fresh window
+# ---------------------------------------------------------------------------
+
+def test_batcher_held_request_window_not_reopened():
+    """Regression: the batching window is anchored at the oldest
+    member's SUBMIT time. A request carried over from a previous batch
+    (held) or aged in the queue has already spent its window and must
+    flush at once; re-stamping it with a fresh max_wait_ms let a steady
+    trickle of full buckets starve an underfull remainder indefinitely."""
+    server, exe, scope, prog, y = _fc_server(max_batch=4,
+                                             max_wait_ms=5000.0)
+    with server:
+        batch = np.ones((3, 4), dtype="float32")
+        a = serve_engine._Request({"x": batch}, 3)
+        b = serve_engine._Request({"x": batch}, 3)
+        # forge both as submitted long ago — their window is spent
+        a.t_submit -= 10.0
+        b.t_submit -= 10.0
+        server._queue.put(a)
+        server._queue.put(b)
+        # a (3 rows) flushes with b held (3+3 > max_batch); b must then
+        # flush immediately too — far inside the 5 s fresh window the
+        # old code would have granted it
+        ra = a.future.result(timeout=2.0)
+        rb = b.future.result(timeout=2.0)
+    ref = _ref(exe, scope, prog, y, batch)
+    assert np.array_equal(ra[0], ref)
+    assert np.array_equal(rb[0], ref)
